@@ -1,0 +1,38 @@
+"""apex_tpu — a TPU-native training-acceleration framework.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of NVIDIA Apex
+(reference: ``limin2021/apex``): mixed-precision training with O0–O3 policies
+and dynamic loss scaling (``apex_tpu.amp``), fully-fused optimizers driven by a
+multi-tensor engine over flattened parameter superblocks
+(``apex_tpu.optimizers``, ``apex_tpu.multi_tensor``), fused
+layernorm/softmax/cross-entropy/attention ops (``apex_tpu.ops``), data-parallel
+gradient reduction and SyncBatchNorm over a device mesh (``apex_tpu.parallel``),
+and Megatron-style tensor/pipeline model parallelism (``apex_tpu.transformer``).
+
+Design notes
+------------
+Unlike the reference — which layers CUDA extensions, monkey-patching, and
+NCCL process groups on top of eager PyTorch — this framework is functional
+and compiler-first:
+
+* precision policies are dtype rules applied to pytrees, not namespace patches;
+* "fused" kernels are Pallas TPU kernels or single fused XLA ops over
+  flattened buffers, not hand-launched CUDA;
+* distribution is a ``jax.sharding.Mesh`` with named axes ("data", "tensor",
+  "pipeline") and XLA collectives (psum/all_gather/psum_scatter/ppermute)
+  riding ICI, not torch.distributed/NCCL.
+
+Reference layer map: /root/reference layout documented in SURVEY.md; the
+per-rank logging formatter mirrors apex/__init__.py:27-39.
+"""
+
+from apex_tpu import amp  # noqa: F401
+from apex_tpu import fp16_utils  # noqa: F401
+from apex_tpu import multi_tensor  # noqa: F401
+from apex_tpu import ops  # noqa: F401
+from apex_tpu import optimizers  # noqa: F401
+from apex_tpu import parallel  # noqa: F401
+from apex_tpu import transformer  # noqa: F401
+from apex_tpu.utils.logging import RankInfoFormatter, get_logger  # noqa: F401
+
+__version__ = "0.1.0"

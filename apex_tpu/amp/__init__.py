@@ -1,0 +1,52 @@
+"""apex_tpu.amp — mixed-precision policies and dynamic loss scaling.
+
+TPU-native re-design of ``apex.amp`` (reference apex/amp/, 2,891 LoC).
+
+The reference works by mutating an eager program: casting modules in place,
+monkey-patching ~150 torch functions with cast wrappers (O1), and patching
+``optimizer.step`` to skip on overflow. None of that exists here — a JAX train
+step is a pure function, so amp becomes data:
+
+* :class:`Properties` / opt levels ``O0``–``O3`` (reference frontend.py:102-191)
+  are frozen dataclasses describing dtype rules;
+* ``initialize`` (reference frontend.py:195) returns casted param pytrees and a
+  loss-scale pytree instead of mutating models/optimizers;
+* :class:`LossScaler` (reference scaler.py:33-217) is a pure function pair
+  (``scale``, ``update``) over a :class:`LossScaleState` carried in the train
+  state; the overflow check is one fused all-finite reduction, and skip-step
+  semantics are branchless ``jnp.where`` over the whole update (no
+  recompilation, no D2H sync — contrast reference scaler.py:200);
+* O1 function casting (reference amp.py:68-177, wrap.py) maps to explicit
+  ``half_function`` / ``float_function`` / ``promote_function`` wrappers and an
+  op-list registry (:mod:`apex_tpu.amp.lists`).
+
+The default "half" dtype on TPU is bfloat16 (which needs no loss scaling —
+scaling stays available for fp16 parity and for gradient-range hygiene).
+"""
+
+from apex_tpu.amp import handle  # noqa: F401
+from apex_tpu.amp.handle import (  # noqa: F401
+    scale_loss,
+    scaled_value_and_grad,
+    skip_or_step,
+)
+from apex_tpu.amp.lists import (  # noqa: F401
+    float_function,
+    half_function,
+    promote_function,
+)
+from apex_tpu.amp.properties import (  # noqa: F401
+    O0,
+    O1,
+    O2,
+    O3,
+    Properties,
+    initialize,
+    opt_levels,
+)
+from apex_tpu.amp.scaler import (  # noqa: F401
+    LossScaler,
+    LossScaleState,
+    load_state_dict,
+    state_dict,
+)

@@ -1,0 +1,68 @@
+"""Functional ``scale_loss`` — the train-step side of amp.
+
+The reference exposes a context manager (apex/amp/handle.py:16-158) that
+yields ``loss*scale``, and on exit unscales grads, updates the scale, and
+patches ``optimizer.step`` to skip on overflow. In a functional train step
+the same protocol is a function transform: :func:`scaled_value_and_grad`
+differentiates the *scaled* loss (so the backward pass runs in the protected
+numeric range), unscales the resulting grads to fp32, and returns a finite
+flag; skip-step semantics become a ``jnp.where`` over the optimizer update
+(see :func:`apex_tpu.optimizers.apply_updates_if_finite`).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler, LossScaleState
+
+
+def scale_loss(loss, scaler: LossScaler, state: LossScaleState):
+    """Scale a loss (the value the reference ctx manager yields,
+    handle.py:107-120). Provided for hand-rolled grad pipelines; prefer
+    :func:`scaled_value_and_grad`."""
+    return scaler.scale(loss, state)
+
+
+def scaled_value_and_grad(
+    loss_fn: Callable,
+    scaler: LossScaler,
+    *,
+    has_aux: bool = False,
+    argnums=0,
+):
+    """``jax.value_and_grad`` with loss scaling + overflow detection fused in.
+
+    Returns ``fn(scale_state, *args) -> ((loss, aux?), grads, finite)`` where
+    ``grads`` are unscaled fp32 and ``finite`` is a scalar bool (the
+    reference's ``overflow`` from scaler.py:197 with inverted sense).
+
+    The backward pass is taken through ``loss * scale`` so intermediate
+    gradients occupy the scaled range (matters for fp16 parity; bf16 is
+    range-safe either way).
+    """
+
+    def wrapped(scale_state: LossScaleState, *args):
+        def scaled(*inner):
+            out = loss_fn(*inner)
+            if has_aux:
+                loss, aux = out
+                return scaler.scale(loss, scale_state), (loss, aux)
+            return scaler.scale(loss := out, scale_state), loss
+
+        (_, payload), grads = jax.value_and_grad(scaled, argnums=argnums, has_aux=True)(*args)
+        grads, finite = scaler.unscale(grads, scale_state)
+        return payload, grads, finite
+
+    return wrapped
+
+
+def skip_or_step(finite, new_tree, old_tree):
+    """Branchless "skip step on overflow" (reference handle.py:127-154
+    patches optimizer.step to a no-op): select old values when not finite."""
+    from apex_tpu.utils.tree import tree_select
+
+    return tree_select(finite, new_tree, old_tree)

@@ -1,0 +1,127 @@
+"""Per-op cast policy (the O1 surface).
+
+The reference implements O1 by monkey-patching ~150 functions across the
+torch namespaces with cast wrappers built from white/black lists
+(apex/amp/lists/torch_overrides.py:7-112, functional_overrides.py,
+tensor_overrides.py; wrappers in apex/amp/wrap.py:10-94). JAX functions are
+pure and the namespace is not patchable in a sane way, so the same policy is
+expressed as explicit wrappers the user (or our modules) applies:
+
+* :func:`half_function` — run in half precision (reference
+  ``amp.half_function``, apex/amp/amp.py:30-36; whitelist FP16_FUNCS);
+* :func:`float_function` — run in fp32 (blacklist FP32_FUNCS);
+* :func:`promote_function` — promote mixed args to the widest dtype
+  (reference CASTS/promote, wrap.py:66-94).
+
+The op lists themselves are kept (mapped to jnp/lax names) both as
+documentation of parity and for :func:`autocast_policy`, which modules like
+``apex_tpu.ops`` consult to pick compute dtypes under O1.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# Reference torch_overrides.py:7-27 — ops that are safe/fast in half
+# (MXU-bound on TPU): keep in bf16.
+FP16_FUNCS = [
+    "conv1d", "conv2d", "conv3d", "conv_transpose1d", "conv_transpose2d",
+    "conv_transpose3d", "conv_general_dilated", "dot", "dot_general",
+    "matmul", "einsum", "mm", "bmm", "addmm", "linear", "prelu",
+]
+
+# Reference torch_overrides.py:29-84 — reductions/transcendentals that need
+# fp32 accumulation.
+FP32_FUNCS = [
+    "acos", "asin", "cosh", "erfinv", "exp", "expm1", "log", "log10",
+    "log2", "log1p", "reciprocal", "rsqrt", "sinh", "tan", "pow",
+    "softmax", "log_softmax", "cumprod", "cumsum", "dist", "mean",
+    "norm", "prod", "std", "sum", "var", "renorm",
+    "cross_entropy", "nll_loss", "l1_loss", "mse_loss", "smooth_l1_loss",
+    "kl_div", "layer_norm", "group_norm", "batch_norm",
+]
+
+# Reference torch_overrides.py:86-111 — binary/ternary ops whose mixed-dtype
+# args are promoted to the widest type.
+CASTS = [
+    "addcdiv", "addcmul", "atan2", "cross", "bilinear", "add", "div",
+    "mul", "sub", "eq", "ge", "gt", "le", "lt", "ne", "equal", "where",
+]
+
+# Reference functional_overrides.py:70-76 — ops amp refuses to run in fp16.
+BANNED_FUNCS = ["binary_cross_entropy"]
+
+
+def _cast_tree(args, kwargs, dtype):
+    def _c(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+            return jnp.asarray(x).astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(_c, args), jax.tree_util.tree_map(_c, kwargs)
+
+
+def half_function(fn, half_dtype=jnp.bfloat16):
+    """Cast floating args to half before calling (reference amp.py:30-36 /
+    wrap.py:10-29 ``make_cast_wrapper``; the fp16 weight cast cache in
+    wrap.py:31-63 is unnecessary — XLA CSEs repeated converts)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        args, kwargs = _cast_tree(args, kwargs, half_dtype)
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def float_function(fn):
+    """Cast floating args to fp32 before calling (reference amp.py:39-44)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        args, kwargs = _cast_tree(args, kwargs, jnp.float32)
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def promote_function(fn):
+    """Promote floating args to their widest common dtype (reference
+    wrap.py:66-94 ``promote``/``sequence_promote``)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        leaves = [
+            x
+            for x in jax.tree_util.tree_leaves((args, kwargs))
+            if hasattr(x, "dtype") and jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+        ]
+        if leaves:
+            widest = functools.reduce(jnp.promote_types, [x.dtype for x in leaves])
+            args, kwargs = _cast_tree(args, kwargs, widest)
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def autocast_policy(op_name: str):
+    """Policy lookup for named ops: 'half' | 'float' | 'promote' | None.
+
+    Used by apex_tpu modules under O1 to pick compute dtype per op, replacing
+    the reference's namespace patching (amp.py:90-171)."""
+    if op_name in BANNED_FUNCS:
+        raise NotImplementedError(
+            f"{op_name} is banned under mixed precision (reference "
+            "functional_overrides.py:70); use a fused, fp32-accumulating "
+            "equivalent from apex_tpu.ops."
+        )
+    if op_name in FP16_FUNCS:
+        return "half"
+    if op_name in FP32_FUNCS:
+        return "float"
+    if op_name in CASTS:
+        return "promote"
+    return None

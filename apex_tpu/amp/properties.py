@@ -1,0 +1,192 @@
+"""Opt-level properties and ``initialize``.
+
+Mirrors the reference frontend (apex/amp/frontend.py): the four knobs of
+``Properties`` (frontend.py:14-25), the O0–O3 property objects
+(frontend.py:102-191), user overrides (frontend.py:336-352), and
+``initialize`` (frontend.py:195) — redesigned as pure data + pure functions.
+
+Reference semantics:
+
+========  ==================  =====================  ==================  =============
+level     cast_model_type     patch functions (O1)   master_weights      loss_scale
+========  ==================  =====================  ==================  =============
+O0        fp32                no                     no                  1.0
+O1        none (per-op cast)  yes                    no                  dynamic
+O2        half                no                     yes                 dynamic
+O3        half                no                     no                  1.0
+========  ==================  =====================  ==================  =============
+
+``keep_batchnorm_fp32`` defaults to True for O2 (frontend.py:124-144).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler
+from apex_tpu.utils.tree import tree_cast
+
+# Param-path substrings treated as normalization params that stay fp32 when
+# keep_batchnorm_fp32 is set (the reference keys off module type,
+# _initialize.py:176-182 / fp16_utils/fp16util.py:22-33; a functional pytree
+# has only names, so we match path components).
+_BN_NAME_HINTS = ("batchnorm", "batch_norm", "bn", "norm", "layernorm", "layer_norm", "ln")
+
+
+@dataclasses.dataclass(frozen=True)
+class Properties:
+    """The amp option set (reference frontend.py:7-97).
+
+    ``half_dtype`` is new: the reference hardcodes fp16; on TPU the native
+    half type is bfloat16.
+    """
+
+    opt_level: str = "O0"
+    cast_model_type: Optional[Any] = None
+    per_op_cast: bool = False  # reference name: patch_torch_functions (O1)
+    keep_batchnorm_fp32: Optional[bool] = None
+    master_weights: Optional[bool] = None
+    loss_scale: Union[float, str] = 1.0
+    half_dtype: Any = jnp.bfloat16
+
+    def with_overrides(self, **kwargs) -> "Properties":
+        """Apply user overrides on top of opt-level defaults
+        (reference frontend.py:336-352)."""
+        kwargs = {k: v for k, v in kwargs.items() if v is not None}
+        if "cast_model_type" in kwargs and kwargs["cast_model_type"] == "half":
+            kwargs["cast_model_type"] = self.half_dtype
+        return dataclasses.replace(self, **kwargs)
+
+
+def _level(opt_level: str, half):
+    if opt_level == "O0":
+        return Properties("O0", jnp.float32, False, False, False, 1.0, half)
+    if opt_level == "O1":
+        return Properties("O1", None, True, None, False, "dynamic", half)
+    if opt_level == "O2":
+        return Properties("O2", half, False, True, True, "dynamic", half)
+    if opt_level == "O3":
+        return Properties("O3", half, False, False, False, 1.0, half)
+    raise ValueError(f"Unexpected optimization level {opt_level}")
+
+
+O0 = _level("O0", jnp.bfloat16)
+O1 = _level("O1", jnp.bfloat16)
+O2 = _level("O2", jnp.bfloat16)
+O3 = _level("O3", jnp.bfloat16)
+opt_levels = {"O0": O0, "O1": O1, "O2": O2, "O3": O3}
+
+
+def _is_bn_path(path) -> bool:
+    for p in path:
+        name = None
+        if hasattr(p, "key"):
+            name = str(p.key)
+        elif hasattr(p, "name"):
+            name = str(p.name)
+        if name is not None and any(h == name.lower() or h in name.lower().split("_") or name.lower().startswith(h) for h in _BN_NAME_HINTS):
+            return True
+    return False
+
+
+def cast_model(params, props: Properties, *, bn_predicate: Callable = _is_bn_path):
+    """Cast a param pytree to the model compute dtype.
+
+    Equivalent of ``convert_network(model, fp16)`` with keep-BN-fp32
+    (reference _initialize.py:176-182 → fp16_utils/fp16util.py:58-77), as a
+    pure pytree cast.
+    """
+    if props.cast_model_type is None:
+        return params
+    target = props.cast_model_type
+
+    def _cast(path, x):
+        if not (hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)):
+            return x
+        if props.keep_batchnorm_fp32 and bn_predicate(path):
+            return x.astype(jnp.float32)
+        return x.astype(target)
+
+    return jax.tree_util.tree_map_with_path(_cast, params)
+
+
+def cast_inputs(batch, props: Properties):
+    """Cast floating inputs to the compute dtype (reference patches
+    ``model.forward`` for this, _initialize.py:190-201)."""
+    if props.cast_model_type is None or props.cast_model_type == jnp.float32:
+        return batch
+    return tree_cast(batch, props.cast_model_type)
+
+
+def master_params(params, props: Properties):
+    """fp32 master copy of the params (reference lazily materialises master
+    weights inside the patched optimizer, _process_optimizer.py:28-90)."""
+    if not props.master_weights:
+        return params
+    return tree_cast(params, jnp.float32)
+
+
+def o2_state_dict(params):
+    """Cast a (possibly half) param pytree to fp32 for checkpointing, so
+    checkpoints are precision-portable (reference ``O2StateDictHook``,
+    _initialize.py:133-142)."""
+    return tree_cast(params, jnp.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class AmpState:
+    """What ``initialize`` hands back: dtype rules + a loss scaler."""
+
+    props: Properties
+    scaler: LossScaler
+
+    def cast_model(self, params, **kw):
+        return cast_model(params, self.props, **kw)
+
+    def cast_inputs(self, batch):
+        return cast_inputs(batch, self.props)
+
+    def master_params(self, params):
+        return master_params(params, self.props)
+
+
+def initialize(
+    opt_level: str = "O1",
+    *,
+    half_dtype=jnp.bfloat16,
+    cast_model_type=None,
+    keep_batchnorm_fp32=None,
+    master_weights=None,
+    loss_scale=None,
+    min_loss_scale=None,
+    max_loss_scale=2.0 ** 24,
+) -> AmpState:
+    """Build an :class:`AmpState` from an opt level + overrides.
+
+    Functional analog of ``amp.initialize`` (reference frontend.py:195-352):
+    instead of mutating models/optimizers it returns the policy and a
+    :class:`LossScaler`; apply ``cast_model``/``master_params`` to your param
+    pytrees and carry ``scaler.init()`` in the train state.
+    """
+    if opt_level not in opt_levels:
+        raise ValueError(
+            f"Unexpected optimization level {opt_level}. Options are 'O0', 'O1', 'O2', 'O3'."
+        )
+    props = _level(opt_level, half_dtype).with_overrides(
+        cast_model_type=cast_model_type,
+        keep_batchnorm_fp32=keep_batchnorm_fp32,
+        master_weights=master_weights,
+        loss_scale=loss_scale,
+    )
+    if props.loss_scale == "dynamic":
+        scaler = LossScaler.dynamic_scaler(
+            min_scale=1.0 if min_loss_scale is None else min_loss_scale,
+            max_scale=max_loss_scale,
+        )
+    else:
+        scaler = LossScaler.static(float(props.loss_scale))
+    return AmpState(props=props, scaler=scaler)

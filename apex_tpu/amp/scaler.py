@@ -1,0 +1,121 @@
+"""Loss scaling as a pure state machine.
+
+Re-design of the reference ``LossScaler`` (apex/amp/scaler.py:33-217) and the
+legacy ``DynamicLossScaler`` (apex/fp16_utils/loss_scaler.py:47-186).
+
+Reference defaults (scaler.py:38-54, :197-217): init scale 2**16, ×2 every
+2000 overflow-free steps, ÷2 on overflow, cap 2**24. The reference polls a
+``noop_flag`` written by every CUDA kernel and does a D2H sync per step
+(scaler.py:200); here the overflow check is a fused all-finite reduction on
+device and the scale update is branchless, so the whole thing stays inside
+one jitted step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.tree import tree_isfinite
+
+
+class LossScaleState(NamedTuple):
+    """Carried in the train state. ``unskipped`` mirrors reference
+    ``LossScaler._unskipped`` (scaler.py:51)."""
+
+    loss_scale: jnp.ndarray  # f32 scalar
+    unskipped: jnp.ndarray  # i32 scalar: overflow-free steps since last growth
+
+
+@dataclasses.dataclass(frozen=True)
+class LossScaler:
+    """Static or dynamic loss scaler (pure functions over LossScaleState)."""
+
+    init_scale: float = 2.0 ** 16
+    scale_factor: float = 2.0
+    scale_window: int = 2000
+    min_scale: float = 1.0
+    max_scale: float = 2.0 ** 24
+    dynamic: bool = True
+
+    @classmethod
+    def static(cls, scale: float) -> "LossScaler":
+        return cls(init_scale=scale, dynamic=False)
+
+    @classmethod
+    def dynamic_scaler(cls, **kw) -> "LossScaler":
+        return cls(dynamic=True, **kw)
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(
+            loss_scale=jnp.asarray(self.init_scale, jnp.float32),
+            unskipped=jnp.asarray(0, jnp.int32),
+        )
+
+    def scale(self, loss, state: LossScaleState):
+        """loss * scale in fp32 (the reference also yields the scaled loss
+        as float, handle.py:113 ``(loss.float())*loss_scale`` — keeping it in
+        the loss dtype would saturate fp16 at scale ≳ 2**15)."""
+        return loss.astype(jnp.float32) * state.loss_scale
+
+    def unscale(self, grads, state: LossScaleState):
+        """Unscale grads to fp32 and report finiteness.
+
+        Fuses the reference's ``multi_tensor_scale`` unscale + inf/nan poll
+        (scaler.py:94-151) into the jitted step. Returns ``(grads, finite)``.
+        """
+        inv = 1.0 / state.loss_scale
+
+        def _unscale(g):
+            if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating):
+                return g.astype(jnp.float32) * inv
+            return g
+
+        # Pin ONE materialisation of the raw grads. Without this, XLA may
+        # duplicate the backward computation into its two consumers (the
+        # isfinite check and the optimizer update) with different fusions /
+        # intermediate precisions, so the check can report finite while the
+        # update consumes an inf — the moral equivalent of the race the
+        # reference avoids by polling noop_flag on the materialised buffers.
+        grads = jax.lax.optimization_barrier(grads)
+        grads = jax.tree_util.tree_map(_unscale, grads)
+        finite = tree_isfinite(grads)
+        return grads, finite
+
+    def update(self, state: LossScaleState, finite) -> LossScaleState:
+        """Branchless scale update (reference ``update_scale``
+        scaler.py:197-217): on overflow scale/=factor, clamp to min_scale,
+        reset the window; else grow ×factor every ``scale_window`` clean
+        steps, capped at max_scale."""
+        if not self.dynamic:
+            return state
+        finite = jnp.asarray(finite)
+        unskipped = jnp.where(finite, state.unskipped + 1, 0)
+        grow = unskipped >= self.scale_window
+        scale = jnp.where(
+            finite,
+            jnp.where(grow, jnp.minimum(state.loss_scale * self.scale_factor, self.max_scale), state.loss_scale),
+            jnp.maximum(state.loss_scale / self.scale_factor, self.min_scale),
+        )
+        unskipped = jnp.where(grow, 0, unskipped)
+        return LossScaleState(loss_scale=scale, unskipped=unskipped)
+
+
+def state_dict(state: LossScaleState) -> dict:
+    """Serializable amp state (reference ``amp.state_dict``,
+    frontend.py:361-370: each scaler's loss_scale + unskipped)."""
+    return {
+        "loss_scale": float(state.loss_scale),
+        "unskipped": int(state.unskipped),
+    }
+
+
+def load_state_dict(d: dict) -> LossScaleState:
+    """Reference frontend.py:373-400."""
+    return LossScaleState(
+        loss_scale=jnp.asarray(d["loss_scale"], jnp.float32),
+        unskipped=jnp.asarray(d["unskipped"], jnp.int32),
+    )

@@ -1,0 +1,33 @@
+"""apex_tpu.multi_tensor — the flattened-parameter multi-tensor engine.
+
+TPU-native re-design of the reference's universal kernel idiom
+``multi_tensor_apply`` (csrc/multi_tensor_apply.cuh:41-133 + the ``amp_C``
+kernel suite, csrc/amp_C_frontend.cpp:123-143).
+
+The reference packs raw pointers of up to 110 irregular tensors into a
+kernel-arg struct and launches one elementwise CUDA kernel across chunks of
+every tensor. A TPU has no pointer-list launches — the idiomatic equivalent
+is a **superblock**: the pytree is flattened once into a single contiguous
+1-D HBM buffer (:class:`FlatSchema` / :func:`flatten` / :func:`unflatten`),
+and every "multi-tensor" op becomes ONE fused XLA/Pallas op over that buffer.
+Per-tensor semantics (per-tensor l2 norms, per-layer trust ratios) are
+recovered with segment reductions over the schema's offset table.
+
+This engine backs all fused optimizers (apex_tpu.optimizers), the loss
+scaler, grad clipping, and ZeRO sharding — exactly the role amp_C plays in
+the reference.
+"""
+
+from apex_tpu.multi_tensor.flat import (  # noqa: F401
+    FlatSchema,
+    flatten,
+    make_schema,
+    unflatten,
+)
+from apex_tpu.multi_tensor.ops import (  # noqa: F401
+    clip_grad_norm,
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_scale,
+    segment_l2norms,
+)

@@ -1,0 +1,112 @@
+"""Superblock pack/unpack.
+
+Replaces ``apex_C.flatten/unflatten`` (reference csrc/flatten_unflatten.cpp:16-17,
+used by DDP bucketing at apex/parallel/distributed.py:13-33) and the
+block/chunk/shard flat-buffer layout of the sharded optimizers
+(contrib/optimizers/distributed_fused_lamb.py:364-434).
+
+Layout choice: leaves are concatenated in pytree order, each padded to a
+multiple of ``align`` (default 128, the TPU lane width) so that every leaf
+starts on a lane boundary and the buffer length divides evenly into shards
+for ZeRO-style ``psum_scatter`` over the data axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class FlatSchema:
+    """Static metadata describing a packed superblock (hashable, safe to
+    close over in jit)."""
+
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[Any, ...]
+    offsets: Tuple[int, ...]  # start offset of each leaf (aligned)
+    sizes: Tuple[int, ...]  # unpadded leaf sizes
+    total: int  # total padded length
+    align: int
+
+    @property
+    def num_tensors(self) -> int:
+        return len(self.shapes)
+
+    def leaf_slice(self, i: int) -> slice:
+        return slice(self.offsets[i], self.offsets[i] + self.sizes[i])
+
+    def segment_ids(self) -> np.ndarray:
+        """Per-element leaf index (padding marked with num_tensors) — the
+        offset table the reference keeps in kernel args
+        (TensorListMetadata, csrc/multi_tensor_apply.cuh:19-26)."""
+        ids = np.full((self.total,), self.num_tensors, np.int32)
+        for i in range(self.num_tensors):
+            ids[self.offsets[i] : self.offsets[i] + self.sizes[i]] = i
+        return ids
+
+
+def _round_up(n: int, align: int) -> int:
+    return (n + align - 1) // align * align
+
+
+def make_schema(tree, *, align: int = 128, total_multiple_of: int = 1) -> FlatSchema:
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes, dtypes, offsets, sizes = [], [], [], []
+    off = 0
+    for leaf in leaves:
+        leaf = jnp.asarray(leaf)
+        shapes.append(tuple(leaf.shape))
+        dtypes.append(leaf.dtype)
+        offsets.append(off)
+        sizes.append(int(leaf.size))
+        off += _round_up(int(leaf.size), align)
+    total = _round_up(off, max(align, total_multiple_of))
+    return FlatSchema(
+        treedef=treedef,
+        shapes=tuple(shapes),
+        dtypes=tuple(dtypes),
+        offsets=tuple(offsets),
+        sizes=tuple(sizes),
+        total=total,
+        align=align,
+    )
+
+
+def flatten(tree, schema: FlatSchema | None = None, *, dtype=None, align: int = 128,
+            total_multiple_of: int = 1):
+    """Pack a pytree into one 1-D buffer. Returns ``(flat, schema)``.
+
+    ``dtype`` forces a cast (e.g. pack bf16 grads into an fp32 superblock —
+    the master-grad materialisation of _process_optimizer.py:161-230).
+    """
+    if schema is None:
+        schema = make_schema(tree, align=align, total_multiple_of=total_multiple_of)
+    leaves = jax.tree_util.tree_leaves(tree)
+    buf_dtype = dtype or jnp.result_type(*schema.dtypes)
+    parts: List[jnp.ndarray] = []
+    pos = 0
+    for i, leaf in enumerate(leaves):
+        leaf = jnp.asarray(leaf).reshape(-1).astype(buf_dtype)
+        pad = schema.offsets[i] - pos
+        if pad:
+            parts.append(jnp.zeros((pad,), buf_dtype))
+        parts.append(leaf)
+        pos = schema.offsets[i] + schema.sizes[i]
+    if schema.total - pos:
+        parts.append(jnp.zeros((schema.total - pos,), buf_dtype))
+    return jnp.concatenate(parts), schema
+
+
+def unflatten(flat, schema: FlatSchema, *, dtype=None):
+    """Rebuild the pytree (views of the superblock)."""
+    leaves = []
+    for i in range(schema.num_tensors):
+        leaf = flat[schema.leaf_slice(i)].reshape(schema.shapes[i])
+        leaves.append(leaf.astype(dtype or schema.dtypes[i]))
+    return jax.tree_util.tree_unflatten(schema.treedef, leaves)

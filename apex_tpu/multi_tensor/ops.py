@@ -1,0 +1,77 @@
+"""The amp_C op suite over superblocks / pytrees.
+
+Reference kernels (csrc/amp_C_frontend.cpp:123-143):
+``multi_tensor_scale`` (with inf/nan poll, csrc/multi_tensor_scale_kernel.cu),
+``multi_tensor_axpby``, ``multi_tensor_l2norm`` (global + per-tensor,
+csrc/multi_tensor_l2norm_kernel.cu). Here each is one fused XLA op; the
+inf/nan poll is an all-finite reduction returned alongside the result
+instead of a host-polled noop_flag.
+
+All ops accept either a 1-D superblock or an arbitrary pytree (applied
+leafwise and reduced) — the pytree path is what optimizers use; the
+superblock path is what ZeRO shards use.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor.flat import FlatSchema
+from apex_tpu.utils.tree import tree_isfinite
+
+
+def multi_tensor_scale(tree, scale):
+    """out = in * scale, plus overflow flag.
+
+    Reference: multi_tensor_scale_kernel.cu via scaler.py:94-151 (the
+    unscale path) and DDP's fp16 copy-back (distributed.py:460-465).
+    Returns ``(scaled_tree, finite)``.
+    """
+    out = jax.tree_util.tree_map(lambda x: x * scale, tree)
+    return out, tree_isfinite(out)
+
+
+def multi_tensor_axpby(x_tree, y_tree, a, b, *, out_dtype=None):
+    """out = a*x + b*y (reference multi_tensor_axpby_kernel.cu, used by
+    ``unscale_with_stashed`` scaler.py:152-189). Returns ``(out, finite)``."""
+
+    def _axpby(x, y):
+        r = a * x.astype(jnp.float32) + b * y.astype(jnp.float32)
+        return r.astype(out_dtype or x.dtype)
+
+    out = jax.tree_util.tree_map(_axpby, x_tree, y_tree)
+    return out, tree_isfinite(out)
+
+
+def multi_tensor_l2norm(tree, *, per_tensor: bool = False):
+    """Global (and optionally per-tensor) l2 norm.
+
+    Reference: multi_tensor_l2norm_kernel.cu (used by FusedLAMB's phase 1,
+    fused_lamb.py:121-136, and grad clipping). Per-tensor = per-leaf here.
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    sq = [jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves]
+    total = jnp.sqrt(sum(sq)) if sq else jnp.asarray(0.0, jnp.float32)
+    if per_tensor:
+        return total, jnp.sqrt(jnp.stack(sq)) if sq else jnp.zeros((0,), jnp.float32)
+    return total
+
+
+def segment_l2norms(flat, schema: FlatSchema):
+    """Per-tensor l2 norms over a superblock via one segment reduction
+    (the per-tensor option of multi_tensor_l2norm over TensorListMetadata
+    offsets)."""
+    ids = jnp.asarray(schema.segment_ids())
+    sq = jax.ops.segment_sum(
+        jnp.square(flat.astype(jnp.float32)), ids, num_segments=schema.num_tensors + 1
+    )
+    return jnp.sqrt(sq[: schema.num_tensors])
+
+
+def clip_grad_norm(tree, max_norm: float, *, eps: float = 1e-6):
+    """Global-norm clip built from l2norm+scale (how the reference composes
+    amp grad clipping from multi_tensor_l2norm + multi_tensor_scale)."""
+    norm = multi_tensor_l2norm(tree)
+    clip = jnp.minimum(1.0, max_norm / (norm + eps))
+    return jax.tree_util.tree_map(lambda x: x * clip, tree), norm

@@ -1,0 +1,20 @@
+"""Shared Pallas helpers.
+
+Kernels run natively on TPU and fall back to Pallas interpret mode on CPU so
+the whole test tier runs hardware-free (SURVEY.md §4 implications).
+"""
+
+from __future__ import annotations
+
+import jax
+
+LANE = 128  # TPU lane width
+SUBLANE_F32 = 8
+
+
+def use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def pad_rows_to(n: int, multiple: int) -> int:
+    return (n + multiple - 1) // multiple * multiple

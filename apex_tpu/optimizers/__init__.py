@@ -1,0 +1,19 @@
+"""apex_tpu.optimizers — fully-fused optimizers.
+
+Re-design of ``apex.optimizers`` (reference apex/optimizers/__init__.py:1-5):
+FusedSGD / FusedAdam / FusedLAMB / FusedNovoGrad / FusedAdagrad with the same
+algorithms and knobs, plus LARC (reference apex/parallel/LARC.py). Instead of
+per-dtype tensor-list launches through ``multi_tensor_applier``
+(fused_adam.py:147-170), each update is one fused XLA computation over the
+param pytree; :mod:`apex_tpu.optimizers.flat` provides the packed-superblock
+Pallas path for many-small-tensor models.
+"""
+
+from apex_tpu.optimizers.base import Optimizer, apply_updates  # noqa: F401
+from apex_tpu.optimizers.flat import FlatAdamState, FlatFusedAdam  # noqa: F401
+from apex_tpu.optimizers.fused_adagrad import AdagradState, FusedAdagrad  # noqa: F401
+from apex_tpu.optimizers.fused_adam import AdamState, FusedAdam  # noqa: F401
+from apex_tpu.optimizers.fused_lamb import FusedLAMB, LambState  # noqa: F401
+from apex_tpu.optimizers.fused_novograd import FusedNovoGrad, NovoGradState  # noqa: F401
+from apex_tpu.optimizers.fused_sgd import FusedSGD, SGDState  # noqa: F401
+from apex_tpu.optimizers.larc import LARC  # noqa: F401

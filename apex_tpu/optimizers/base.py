@@ -1,0 +1,79 @@
+"""Common optimizer machinery.
+
+The reference optimizers are drop-in ``torch.optim.Optimizer`` replacements
+that gather param/grad/state lists per dtype and fire one
+``multi_tensor_applier`` per group (apex/optimizers/fused_adam.py:147-170).
+Here the whole update is one fused XLA computation over the param pytree —
+the superblock/Pallas path (:mod:`apex_tpu.optimizers.flat`) exists for the
+cases where packing wins (many small tensors, ZeRO shards).
+
+API: optax-style ``init(params) -> state`` / ``update(grads, state, params)
+-> (updates, state)`` plus a ``step`` convenience that applies updates and a
+``skip-step on overflow`` composition point for amp.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class Optimizer:
+    """Base for apex-style fused optimizers (functional)."""
+
+    def init(self, params) -> Any:
+        raise NotImplementedError
+
+    def update(self, grads, state, params):
+        raise NotImplementedError
+
+    def step(self, grads, state, params):
+        """Apply one optimizer step: returns ``(new_params, new_state)``."""
+        updates, state = self.update(grads, state, params)
+        return apply_updates(params, updates), state
+
+    def step_if_finite(self, grads, state, params, finite):
+        """amp-integrated step: branchless skip on overflow (the reference
+        patches optimizer.step to a warning no-op, handle.py:127-154; the
+        dynamic scale state machine handles the rest)."""
+        from apex_tpu.utils.tree import tree_select
+
+        new_params, new_state = self.step(grads, state, params)
+        return tree_select(finite, new_params, params), tree_select(finite, new_state, state)
+
+    def as_gradient_transformation(self):
+        """Expose as an optax ``GradientTransformation`` for ecosystem
+        composition."""
+        import optax
+
+        return optax.GradientTransformation(
+            init=self.init,
+            update=lambda g, s, p=None: self.update(g, s, p),
+        )
+
+
+def apply_updates(params, updates):
+    return jax.tree_util.tree_map(
+        lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype), params, updates
+    )
+
+
+def _f32(x):
+    return x.astype(jnp.float32)
+
+
+def tree_map(fn, *trees):
+    return jax.tree_util.tree_map(fn, *trees)
+
+
+def tree_multimap_split(fn, n_out: int, *trees):
+    """tree_map a function returning an ``n_out``-tuple; returns ``n_out``
+    trees (one per output). Safe regardless of leaf types."""
+    flat_trees = [jax.tree_util.tree_flatten(t) for t in trees]
+    treedef = flat_trees[0][1]
+    outs = [fn(*leaves) for leaves in zip(*(f[0] for f in flat_trees))]
+    return tuple(
+        jax.tree_util.tree_unflatten(treedef, [o[i] for o in outs]) for i in range(n_out)
+    )

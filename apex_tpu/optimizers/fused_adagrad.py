@@ -1,0 +1,52 @@
+"""FusedAdagrad.
+
+Parity with reference ``FusedAdagrad`` (apex/optimizers/fused_adagrad.py:5-121;
+kernel csrc/multi_tensor_adagrad.cu): ``adagrad_w_mode`` selects decoupled
+weight decay vs L2-into-grad.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.base import Optimizer, _f32, tree_map, tree_multimap_split
+
+
+class AdagradState(NamedTuple):
+    sum: object
+
+
+class FusedAdagrad(Optimizer):
+    def __init__(
+        self,
+        lr: float = 1e-2,
+        eps: float = 1e-10,
+        weight_decay: float = 0.0,
+        adagrad_w_mode: bool = False,
+    ):
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adagrad_w_mode = adagrad_w_mode
+
+    def init(self, params) -> AdagradState:
+        return AdagradState(sum=tree_map(lambda x: jnp.zeros(x.shape, jnp.float32), params))
+
+    def update(self, grads, state: AdagradState, params):
+        wd = self.weight_decay
+
+        def _leaf(g, p, h):
+            g = _f32(g)
+            p32 = _f32(p)
+            if wd and not self.adagrad_w_mode:
+                g = g + wd * p32
+            h = h + g * g
+            upd = -self.lr * g / (jnp.sqrt(h) + self.eps)
+            if wd and self.adagrad_w_mode:
+                upd = upd - self.lr * wd * p32
+            return upd, h
+
+        updates, h = tree_multimap_split(_leaf, 2, grads, params, state.sum)
+        return updates, AdagradState(sum=h)

@@ -1,0 +1,76 @@
+"""FusedAdam — Adam/AdamW as one fused update.
+
+Algorithm parity with the reference ``FusedAdam``
+(apex/optimizers/fused_adam.py:4-173; kernel csrc/multi_tensor_adam.cu:23-171
+``AdamFunctor``): ``adam_w_mode`` selects decoupled weight decay (AdamW) vs
+L2-into-grad, ``bias_correction`` applies the 1/(1-beta^t) corrections.
+The reference fuses all tensors into ~1 kernel launch; XLA fuses the whole
+tree_map into one computation — same effect, no launcher.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.base import Optimizer, _f32, tree_map, tree_multimap_split
+
+
+class AdamState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: object  # m
+    exp_avg_sq: object  # v
+
+
+class FusedAdam(Optimizer):
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        bias_correction: bool = True,
+        betas=(0.9, 0.999),
+        eps: float = 1e-8,
+        adam_w_mode: bool = True,
+        weight_decay: float = 0.0,
+        amsgrad: bool = False,
+    ):
+        if amsgrad:
+            # parity: reference raises too (fused_adam.py:79-80)
+            raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.adam_w_mode = adam_w_mode
+        self.weight_decay = weight_decay
+
+    def init(self, params) -> AdamState:
+        f32 = lambda t: tree_map(lambda x: jnp.zeros(x.shape, jnp.float32), t)
+        return AdamState(step=jnp.zeros((), jnp.int32), exp_avg=f32(params), exp_avg_sq=f32(params))
+
+    def update(self, grads, state: AdamState, params):
+        step = state.step + 1
+        b1, b2 = self.beta1, self.beta2
+        if self.bias_correction:
+            c1 = 1.0 - b1 ** step.astype(jnp.float32)
+            c2 = 1.0 - b2 ** step.astype(jnp.float32)
+        else:
+            c1 = c2 = jnp.asarray(1.0, jnp.float32)
+
+        def _leaf(g, p, m, v):
+            g = _f32(g)
+            p32 = _f32(p)
+            if not self.adam_w_mode and self.weight_decay:
+                g = g + self.weight_decay * p32  # L2 mode (AdamFunctor ADAM_MODE_1)
+            m = b1 * m + (1.0 - b1) * g
+            v = b2 * v + (1.0 - b2) * g * g
+            denom = jnp.sqrt(v / c2) + self.eps
+            upd = -self.lr * (m / c1) / denom
+            if self.adam_w_mode and self.weight_decay:
+                upd = upd - self.lr * self.weight_decay * p32  # decoupled (ADAM_MODE_0)
+            return upd, m, v
+
+        updates, m, v = tree_multimap_split(
+            _leaf, 3, grads, params, state.exp_avg, state.exp_avg_sq
+        )
+        return updates, AdamState(step=step, exp_avg=m, exp_avg_sq=v)

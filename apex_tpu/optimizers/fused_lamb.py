@@ -1,0 +1,104 @@
+"""FusedLAMB — layerwise adaptive large-batch optimizer.
+
+Parity with reference ``FusedLAMB`` (apex/optimizers/fused_lamb.py:96-215;
+kernel csrc/multi_tensor_lamb.cu): two phases —
+
+1. global grad l2 norm over ALL params (reference launches
+   ``multi_tensor_l2norm`` per dtype group then blends, fused_lamb.py:121-136;
+   here one fused reduction), optionally clipped by ``max_grad_norm``:
+   every grad is divided by ``max(1, global_norm/max_grad_norm)``;
+2. per-tensor Adam moments + trust ratio
+   ``ratio = ||p|| / ||m_hat/(sqrt(v_hat)+eps) + wd*p||`` applied to lr.
+   ``use_nvlamb`` applies the ratio even for params with zero weight decay
+   (reference kernel's NVLAMB switch).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor.ops import multi_tensor_l2norm
+from apex_tpu.optimizers.base import Optimizer, _f32, tree_map, tree_multimap_split
+
+
+class LambState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: object
+    exp_avg_sq: object
+
+
+class FusedLAMB(Optimizer):
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        bias_correction: bool = True,
+        betas=(0.9, 0.999),
+        eps: float = 1e-6,
+        weight_decay: float = 0.01,
+        amsgrad: bool = False,
+        adam_w_mode: bool = True,
+        grad_averaging: bool = True,
+        max_grad_norm: float = 1.0,
+        use_nvlamb: bool = False,
+    ):
+        if amsgrad:
+            raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
+        if not adam_w_mode:
+            raise RuntimeError("FusedLAMB only supports adam_w_mode (reference kernel mode 0 unused).")
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.grad_averaging = grad_averaging
+        self.max_grad_norm = max_grad_norm
+        self.use_nvlamb = use_nvlamb
+
+    def init(self, params) -> LambState:
+        z = lambda t: tree_map(lambda x: jnp.zeros(x.shape, jnp.float32), t)
+        return LambState(step=jnp.zeros((), jnp.int32), exp_avg=z(params), exp_avg_sq=z(params))
+
+    def update(self, grads, state: LambState, params):
+        step = state.step + 1
+        b1, b2 = self.beta1, self.beta2
+        beta3 = 1.0 - b1 if self.grad_averaging else 1.0
+        if self.bias_correction:
+            c1 = 1.0 - b1 ** step.astype(jnp.float32)
+            c2 = 1.0 - b2 ** step.astype(jnp.float32)
+        else:
+            c1 = c2 = jnp.asarray(1.0, jnp.float32)
+
+        # phase 1: global grad norm (+ optional clip)
+        global_norm = multi_tensor_l2norm(grads)
+        if self.max_grad_norm:
+            clip = jnp.maximum(1.0, global_norm / self.max_grad_norm)
+        else:
+            clip = jnp.asarray(1.0, jnp.float32)
+
+        wd = self.weight_decay
+
+        def _leaf(g, p, m, v):
+            g = _f32(g) / clip
+            p32 = _f32(p)
+            m = b1 * m + beta3 * g
+            v = b2 * v + (1.0 - b2) * g * g
+            upd = (m / c1) / (jnp.sqrt(v / c2) + self.eps)
+            if wd:
+                upd = upd + wd * p32
+            w_norm = jnp.sqrt(jnp.sum(p32 * p32))
+            u_norm = jnp.sqrt(jnp.sum(upd * upd))
+            apply_ratio = (wd != 0.0) or self.use_nvlamb
+            if apply_ratio:
+                ratio = jnp.where(
+                    (w_norm > 0.0) & (u_norm > 0.0), w_norm / u_norm, 1.0
+                )
+            else:
+                ratio = 1.0
+            return -self.lr * ratio * upd, m, v
+
+        updates, m, v = tree_multimap_split(
+            _leaf, 3, grads, params, state.exp_avg, state.exp_avg_sq
+        )
+        return updates, LambState(step=step, exp_avg=m, exp_avg_sq=v)

@@ -1,0 +1,87 @@
+"""FusedNovoGrad — per-layer second-moment Adam variant.
+
+Parity with reference ``FusedNovoGrad`` (apex/optimizers/fused_novograd.py:4-214;
+kernel csrc/multi_tensor_novograd.cu): the second moment is a per-TENSOR
+scalar — ``norm_type=2`` uses the grad l2 norm (the only type the reference
+kernel implements), ``init_zero`` selects v_0 = 0 vs v_0 = ||g_1||²,
+``reg_inside_moment`` moves weight decay inside the first moment.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.base import Optimizer, _f32, tree_map, tree_multimap_split
+
+
+class NovoGradState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: object
+    exp_avg_sq: object  # per-leaf scalar
+
+
+class FusedNovoGrad(Optimizer):
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        bias_correction: bool = True,
+        betas=(0.95, 0.98),
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+        grad_averaging: bool = True,
+        reg_inside_moment: bool = False,
+        norm_type: int = 2,
+        init_zero: bool = False,
+    ):
+        if norm_type != 2:
+            raise RuntimeError("FusedNovoGrad only supports l2 norm_type=2 (as does the reference kernel).")
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.grad_averaging = grad_averaging
+        self.reg_inside_moment = reg_inside_moment
+        self.init_zero = init_zero
+
+    def init(self, params) -> NovoGradState:
+        m = tree_map(lambda x: jnp.zeros(x.shape, jnp.float32), params)
+        v = tree_map(lambda x: jnp.zeros((), jnp.float32), params)
+        return NovoGradState(step=jnp.zeros((), jnp.int32), exp_avg=m, exp_avg_sq=v)
+
+    def update(self, grads, state: NovoGradState, params):
+        step = state.step + 1
+        first = state.step == 0
+        b1, b2 = self.beta1, self.beta2
+        beta3 = 1.0 - b1 if self.grad_averaging else 1.0
+        if self.bias_correction:
+            c1 = 1.0 - b1 ** step.astype(jnp.float32)
+            c2 = 1.0 - b2 ** step.astype(jnp.float32)
+        else:
+            c1 = c2 = jnp.asarray(1.0, jnp.float32)
+        wd = self.weight_decay
+
+        def _leaf(g, p, m, v):
+            g = _f32(g)
+            p32 = _f32(p)
+            g_norm_sq = jnp.sum(g * g)
+            if self.init_zero:
+                new_v = b2 * v + (1.0 - b2) * g_norm_sq
+            else:
+                new_v = jnp.where(first, g_norm_sq, b2 * v + (1.0 - b2) * g_norm_sq)
+            denom = jnp.sqrt(new_v / c2) + self.eps
+            gn = g / denom
+            if wd and self.reg_inside_moment:
+                gn = gn + wd * p32
+            m = b1 * m + beta3 * gn
+            upd = m / c1
+            if wd and not self.reg_inside_moment:
+                upd = upd + wd * p32
+            return -self.lr * upd, m, new_v
+
+        updates, m, v = tree_multimap_split(
+            _leaf, 3, grads, params, state.exp_avg, state.exp_avg_sq
+        )
+        return updates, NovoGradState(step=step, exp_avg=m, exp_avg_sq=v)

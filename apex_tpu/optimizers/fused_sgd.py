@@ -1,0 +1,72 @@
+"""FusedSGD — momentum SGD as one fused update.
+
+Parity with reference ``FusedSGD`` (apex/optimizers/fused_sgd.py:6-227;
+kernel csrc/multi_tensor_sgd_kernel.cu): momentum with dampening, Nesterov,
+and ``wd_after_momentum``. The reference's depth-4 launch sets that fuse the
+fp32→fp16 master-param copy into the update (fused_sgd.py:120-195) are
+unnecessary here: :meth:`step` updates fp32 masters and the amp policy's
+``cast_model`` produces the compute copy in the same jitted step, which XLA
+fuses end-to-end.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.base import Optimizer, _f32, tree_map, tree_multimap_split
+
+
+class SGDState(NamedTuple):
+    step: jnp.ndarray
+    momentum_buffer: object
+
+
+class FusedSGD(Optimizer):
+    def __init__(
+        self,
+        lr: float,
+        momentum: float = 0.0,
+        dampening: float = 0.0,
+        weight_decay: float = 0.0,
+        nesterov: bool = False,
+        wd_after_momentum: bool = False,
+    ):
+        if nesterov and (momentum <= 0 or dampening != 0):
+            raise ValueError("Nesterov momentum requires a momentum and zero dampening")
+        self.lr = lr
+        self.momentum = momentum
+        self.dampening = dampening
+        self.weight_decay = weight_decay
+        self.nesterov = nesterov
+        self.wd_after_momentum = wd_after_momentum
+
+    def init(self, params) -> SGDState:
+        buf = tree_map(lambda x: jnp.zeros(x.shape, jnp.float32), params)
+        return SGDState(step=jnp.zeros((), jnp.int32), momentum_buffer=buf)
+
+    def update(self, grads, state: SGDState, params):
+        first = state.step == 0
+        wd = self.weight_decay
+
+        def _leaf(g, p, buf):
+            g = _f32(g)
+            p32 = _f32(p)
+            if wd and not self.wd_after_momentum:
+                g = g + wd * p32
+            if self.momentum:
+                # first step: buf = g (torch semantics, mirrored by the kernel)
+                new_buf = jnp.where(
+                    first, g, self.momentum * buf + (1.0 - self.dampening) * g
+                )
+                d = g + self.momentum * new_buf if self.nesterov else new_buf
+            else:
+                new_buf = buf
+                d = g
+            if wd and self.wd_after_momentum:
+                d = d + wd * p32
+            return -self.lr * d, new_buf
+
+        updates, buf = tree_multimap_split(_leaf, 2, grads, params, state.momentum_buffer)
+        return updates, SGDState(step=state.step + 1, momentum_buffer=buf)

@@ -1,0 +1,62 @@
+"""LARC — layerwise adaptive rate control as a gradient transform.
+
+Parity with reference ``LARC`` (apex/parallel/LARC.py:5-107), which wraps an
+optimizer and mutates grads in-place before its step:
+
+    adaptive_lr = trust_coefficient * ||p|| / (||g|| + wd*||p|| + eps)
+    clip mode:  g = g * min(adaptive_lr / lr, 1);  g += wd * p
+    scale mode: g = g * adaptive_lr;               g += wd * p
+
+Here it is a pure grad transform composed in front of any
+:class:`apex_tpu.optimizers.base.Optimizer` (weight decay is folded into the
+grad exactly as the reference does, so the inner optimizer should be given
+weight_decay=0 — mirroring how LARC zeroes the wrapped group's wd,
+LARC.py:91-104).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.base import Optimizer, _f32, tree_map
+
+
+class LARC(Optimizer):
+    def __init__(
+        self,
+        optimizer: Optimizer,
+        trust_coefficient: float = 0.02,
+        clip: bool = True,
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+    ):
+        self.inner = optimizer
+        self.trust_coefficient = trust_coefficient
+        self.clip = clip
+        self.eps = eps
+        self.weight_decay = weight_decay
+
+    def init(self, params):
+        return self.inner.init(params)
+
+    def transform_grads(self, grads, params):
+        lr = getattr(self.inner, "lr", 1.0)
+        wd = self.weight_decay
+
+        def _leaf(g, p):
+            g = _f32(g)
+            p32 = _f32(p)
+            p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+            g_norm = jnp.sqrt(jnp.sum(g * g))
+            adaptive_lr = self.trust_coefficient * p_norm / (g_norm + p_norm * wd + self.eps)
+            if self.clip:
+                adaptive_lr = jnp.minimum(adaptive_lr / lr, 1.0)
+            transformed = (g + wd * p32) * adaptive_lr
+            # reference skips params with zero param/grad norm entirely —
+            # grad left untouched, no wd fold-in (LARC.py:92-102)
+            return jnp.where((p_norm > 0.0) & (g_norm > 0.0), transformed, g)
+
+        return tree_map(_leaf, grads, params)
+
+    def update(self, grads, state, params):
+        return self.inner.update(self.transform_grads(grads, params), state, params)

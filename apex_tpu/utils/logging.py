@@ -1,0 +1,63 @@
+"""Rank-aware logging.
+
+TPU-native equivalent of the reference's per-rank log formatter
+(apex/__init__.py:27-39, which injects ``(tp, pp, dp)`` rank info into every
+record) and the transformer logger (apex/transformer/log_util.py:1-19).
+
+On TPU there are no torch.distributed process groups; rank info comes from
+``jax.process_index()`` and, when a model-parallel mesh has been initialised
+via :mod:`apex_tpu.transformer.parallel_state`, the logical mesh coordinates.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+
+class RankInfoFormatter(logging.Formatter):
+    """Formatter that prefixes records with process/mesh rank info.
+
+    Mirrors ``RankInfoFormatter`` (reference apex/__init__.py:27-39), with
+    jax.process_index in place of torch.distributed.get_rank and mesh
+    coordinates from parallel_state in place of (tp, pp, dp) group ranks.
+    """
+
+    def format(self, record):
+        try:
+            import jax
+
+            rank = jax.process_index()
+            nprocs = jax.process_count()
+        except Exception:  # pragma: no cover - jax not initialised yet
+            rank, nprocs = 0, 1
+        try:
+            from apex_tpu.transformer import parallel_state
+
+            if parallel_state.model_parallel_is_initialized():
+                info = parallel_state.get_rank_info()
+                record.rank_info = f"[{rank}/{nprocs} tp={info[0]} pp={info[1]} dp={info[2]}]"
+            else:
+                record.rank_info = f"[{rank}/{nprocs}]"
+        except Exception:
+            record.rank_info = f"[{rank}/{nprocs}]"
+        return super().format(record)
+
+
+_FORMAT = "%(asctime)s %(rank_info)s %(name)s %(levelname)s: %(message)s"
+
+
+def get_logger(name: str = "apex_tpu") -> logging.Logger:
+    """Per-module logger with env-var level (APEX_TPU_LOG_LEVEL).
+
+    Mirrors get_transformer_logger / set_logging_level
+    (reference apex/transformer/log_util.py:1-19).
+    """
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(RankInfoFormatter(_FORMAT))
+        logger.addHandler(handler)
+        logger.setLevel(os.environ.get("APEX_TPU_LOG_LEVEL", "WARNING").upper())
+        logger.propagate = False
+    return logger

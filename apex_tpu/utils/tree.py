@@ -1,0 +1,64 @@
+"""Pytree utilities shared across the framework."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_cast(tree, dtype, *, predicate=None):
+    """Cast every floating-point leaf to ``dtype``.
+
+    ``predicate(path, leaf) -> bool`` (path = jax key path tuple) may veto
+    individual leaves (used by keep_batchnorm_fp32-style policies).
+    """
+
+    def _cast(path, x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            if predicate is None or predicate(path, x):
+                return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map_with_path(_cast, tree)
+
+
+def tree_select(pred, on_true, on_false):
+    """Branchless whole-tree select: ``where(pred, a, b)`` per leaf. The
+    skip-step primitive shared by amp and the optimizers."""
+    return jax.tree_util.tree_map(lambda a, b: jnp.where(pred, a, b), on_true, on_false)
+
+
+def tree_size(tree) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree))
+
+
+def tree_zeros_like(tree):
+    return jax.tree_util.tree_map(jnp.zeros_like, tree)
+
+
+def tree_isfinite(tree):
+    """Single fused all-finite check over a whole pytree.
+
+    TPU-native replacement for the inf/nan poll that every reference
+    multi-tensor kernel carries (csrc/multi_tensor_apply.cuh:32 noop_flag):
+    one ``jnp.isfinite(...).all()`` per leaf, AND-reduced to a scalar.
+    """
+    leaves = [x for x in jax.tree_util.tree_leaves(tree) if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)]
+    if not leaves:
+        return jnp.asarray(True)
+    finite = [jnp.isfinite(x).all() for x in leaves]
+    out = finite[0]
+    for f in finite[1:]:
+        out = jnp.logical_and(out, f)
+    return out
+
+
+def tree_global_norm(tree, *, ord=2):
+    """Global l2 norm over all leaves (reference multi_tensor_l2norm semantics)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    if ord != 2:
+        raise NotImplementedError("only l2 supported")
+    sq = sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+    return jnp.sqrt(sq)

@@ -1,0 +1,198 @@
+"""amp unit tests.
+
+Mirrors tests/L0/run_amp in the reference: casting behavior per opt level
+(test_basic_casts.py), promotion rules (test_promotion.py), loss-scale
+dynamics, and checkpoint round-trip (test_checkpointing.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+
+
+def make_params():
+    return {
+        "dense": {"kernel": jnp.ones((4, 4), jnp.float32), "bias": jnp.zeros((4,), jnp.float32)},
+        "batch_norm": {"scale": jnp.ones((4,), jnp.float32), "bias": jnp.zeros((4,), jnp.float32)},
+    }
+
+
+class TestOptLevels:
+    def test_o0_identity(self):
+        a = amp.initialize("O0")
+        p = a.cast_model(make_params())
+        assert p["dense"]["kernel"].dtype == jnp.float32
+        assert a.scaler.dynamic is False and a.scaler.init_scale == 1.0
+
+    def test_o2_casts_but_keeps_bn_fp32(self):
+        a = amp.initialize("O2")
+        p = a.cast_model(make_params())
+        assert p["dense"]["kernel"].dtype == jnp.bfloat16
+        assert p["batch_norm"]["scale"].dtype == jnp.float32
+        m = a.master_params(make_params())
+        assert m["dense"]["kernel"].dtype == jnp.float32
+        assert a.scaler.dynamic is True
+
+    def test_o3_pure_half(self):
+        a = amp.initialize("O3")
+        p = a.cast_model(make_params())
+        assert p["batch_norm"]["scale"].dtype == jnp.bfloat16
+        assert a.scaler.dynamic is False
+
+    def test_o1_no_model_cast(self):
+        a = amp.initialize("O1")
+        p = a.cast_model(make_params())
+        assert p["dense"]["kernel"].dtype == jnp.float32
+
+    def test_overrides(self):
+        a = amp.initialize("O2", keep_batchnorm_fp32=False, loss_scale=128.0)
+        p = a.cast_model(make_params())
+        assert p["batch_norm"]["scale"].dtype == jnp.bfloat16
+        assert a.scaler.dynamic is False and a.scaler.init_scale == 128.0
+
+    def test_bad_level(self):
+        with pytest.raises(ValueError):
+            amp.initialize("O4")
+
+    def test_fp16_half_dtype(self):
+        a = amp.initialize("O2", half_dtype=jnp.float16)
+        p = a.cast_model(make_params())
+        assert p["dense"]["kernel"].dtype == jnp.float16
+
+
+class TestLossScaler:
+    def test_dynamic_defaults_match_reference(self):
+        s = amp.LossScaler.dynamic_scaler()
+        # reference scaler.py:38-54
+        assert s.init_scale == 2.0 ** 16
+        assert s.scale_factor == 2.0
+        assert s.scale_window == 2000
+        assert s.max_scale == 2.0 ** 24
+
+    def test_overflow_halves(self):
+        s = amp.LossScaler.dynamic_scaler()
+        st = s.init()
+        st = s.update(st, jnp.asarray(False))
+        assert float(st.loss_scale) == 2.0 ** 15
+        assert int(st.unskipped) == 0
+
+    def test_growth_after_window(self):
+        s = amp.LossScaler.dynamic_scaler(scale_window=3, init_scale=4.0)
+        st = s.init()
+        for _ in range(3):
+            st = s.update(st, True)
+        assert float(st.loss_scale) == 8.0
+        assert int(st.unskipped) == 0
+
+    def test_cap_at_max(self):
+        s = amp.LossScaler.dynamic_scaler(scale_window=1, init_scale=2.0 ** 24)
+        st = s.update(s.init(), True)
+        assert float(st.loss_scale) == 2.0 ** 24
+
+    def test_floor_at_min(self):
+        s = amp.LossScaler.dynamic_scaler(init_scale=1.0, min_scale=1.0)
+        st = s.update(s.init(), False)
+        assert float(st.loss_scale) == 1.0
+
+    def test_static_never_moves(self):
+        s = amp.LossScaler.static(128.0)
+        st = s.update(s.init(), False)
+        assert float(st.loss_scale) == 128.0
+
+    def test_unscale_detects_inf_and_nan(self):
+        s = amp.LossScaler.dynamic_scaler(init_scale=2.0)
+        st = s.init()
+        grads = {"a": jnp.asarray([1.0, jnp.inf]), "b": jnp.ones((2,))}
+        g, finite = s.unscale(grads, st)
+        assert not bool(finite)
+        grads = {"a": jnp.asarray([1.0, 2.0]), "b": jnp.ones((2,))}
+        g, finite = s.unscale(grads, st)
+        assert bool(finite)
+        np.testing.assert_allclose(g["a"], [0.5, 1.0])
+
+    def test_state_dict_roundtrip(self):
+        s = amp.LossScaler.dynamic_scaler()
+        st = s.update(s.init(), True)
+        d = amp.state_dict(st)
+        st2 = amp.load_state_dict(d)
+        assert float(st2.loss_scale) == float(st.loss_scale)
+        assert int(st2.unskipped) == 1
+
+
+class TestScaledValueAndGrad:
+    def test_grads_match_unscaled(self):
+        s = amp.LossScaler.dynamic_scaler(init_scale=2.0 ** 10)
+        st = s.init()
+
+        def loss_fn(p, x):
+            return jnp.sum((x @ p) ** 2)
+
+        p = jnp.ones((3, 3))
+        x = jnp.arange(6.0).reshape(2, 3)
+        vg = amp.scaled_value_and_grad(loss_fn, s)
+        loss, grads, finite = vg(st, p, x)
+        ref_loss, ref_grads = jax.value_and_grad(loss_fn)(p, x)
+        assert bool(finite)
+        np.testing.assert_allclose(loss, ref_loss, rtol=1e-6)
+        np.testing.assert_allclose(grads, ref_grads, rtol=1e-5)
+        assert grads.dtype == jnp.float32
+
+    def test_has_aux(self):
+        s = amp.LossScaler.static(4.0)
+        st = s.init()
+
+        def loss_fn(p):
+            return jnp.sum(p**2), {"metric": jnp.sum(p)}
+
+        vg = amp.scaled_value_and_grad(loss_fn, s, has_aux=True)
+        (loss, aux), grads, finite = vg(st, jnp.ones((2,)))
+        assert float(loss) == 2.0
+        assert float(aux["metric"]) == 2.0
+        np.testing.assert_allclose(grads, [2.0, 2.0])
+
+    def test_overflow_flag_under_jit(self):
+        s = amp.LossScaler.dynamic_scaler(init_scale=2.0)
+        st = s.init()
+
+        def loss_fn(p):
+            return jnp.sum(p * jnp.asarray([1.0, jnp.nan]))
+
+        vg = jax.jit(amp.scaled_value_and_grad(loss_fn, s))
+        _, grads, finite = vg(st, jnp.ones((2,)))
+        assert not bool(finite)
+
+    def test_skip_or_step(self):
+        new = {"w": jnp.ones((2,))}
+        old = {"w": jnp.zeros((2,))}
+        kept = amp.handle.skip_or_step(jnp.asarray(False), new, old)
+        np.testing.assert_allclose(kept["w"], [0.0, 0.0])
+        stepped = amp.handle.skip_or_step(jnp.asarray(True), new, old)
+        np.testing.assert_allclose(stepped["w"], [1.0, 1.0])
+
+
+class TestCastWrappers:
+    def test_half_function(self):
+        f = amp.half_function(lambda x: x)
+        assert f(jnp.ones((2,), jnp.float32)).dtype == jnp.bfloat16
+
+    def test_float_function(self):
+        f = amp.float_function(lambda x: x)
+        assert f(jnp.ones((2,), jnp.bfloat16)).dtype == jnp.float32
+
+    def test_promote_function(self):
+        f = amp.promote_function(lambda x, y: (x, y))
+        a, b = f(jnp.ones((2,), jnp.bfloat16), jnp.ones((2,), jnp.float32))
+        assert a.dtype == jnp.float32 and b.dtype == jnp.float32
+
+    def test_policy_lookup(self):
+        from apex_tpu.amp import lists
+
+        assert lists.autocast_policy("matmul") == "half"
+        assert lists.autocast_policy("softmax") == "float"
+        assert lists.autocast_policy("add") == "promote"
+        assert lists.autocast_policy("relu") is None
+        with pytest.raises(NotImplementedError):
+            lists.autocast_policy("binary_cross_entropy")

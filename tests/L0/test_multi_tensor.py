@@ -1,0 +1,112 @@
+"""Multi-tensor engine tests.
+
+Mirrors reference tests/L0/run_amp/test_multi_tensor_{scale,axpby,l2norm}.py:
+ops vs plain math, including inf/nan propagation across a long tensor list.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import multi_tensor as mt
+
+
+def rand_tree(rng, n_tensors=12, dtype=np.float32):
+    return {
+        f"t{i}": jnp.asarray(rng.standard_normal((rng.integers(1, 50),)).astype(dtype))
+        for i in range(n_tensors)
+    }
+
+
+class TestFlatten:
+    def test_roundtrip(self):
+        rng = np.random.default_rng(0)
+        tree = rand_tree(rng)
+        flat, schema = mt.flatten(tree)
+        assert flat.ndim == 1 and flat.size == schema.total
+        back = mt.unflatten(flat, schema)
+        for k in tree:
+            np.testing.assert_array_equal(back[k], tree[k])
+
+    def test_alignment(self):
+        tree = {"a": jnp.ones((3,)), "b": jnp.ones((130,))}
+        flat, schema = mt.flatten(tree, align=128)
+        assert schema.offsets == (0, 128)
+        assert schema.total == 128 + 256
+
+    def test_total_multiple(self):
+        tree = {"a": jnp.ones((3,))}
+        flat, schema = mt.flatten(tree, total_multiple_of=1024)
+        assert schema.total == 1024
+
+    def test_mixed_dtypes_cast(self):
+        tree = {"a": jnp.ones((4,), jnp.bfloat16), "b": jnp.ones((4,), jnp.float32)}
+        flat, schema = mt.flatten(tree, dtype=jnp.float32)
+        assert flat.dtype == jnp.float32
+        back = mt.unflatten(flat, schema)
+        assert back["a"].dtype == jnp.bfloat16
+        assert back["b"].dtype == jnp.float32
+
+    def test_segment_ids(self):
+        tree = {"a": jnp.ones((3,)), "b": jnp.ones((2,))}
+        _, schema = mt.flatten(tree, align=4)
+        ids = schema.segment_ids()
+        np.testing.assert_array_equal(ids[:3], [0, 0, 0])
+        np.testing.assert_array_equal(ids[4:6], [1, 1])
+        assert ids[3] == 2  # padding marker
+
+
+class TestOps:
+    def test_scale(self):
+        rng = np.random.default_rng(1)
+        tree = rand_tree(rng)
+        out, finite = mt.multi_tensor_scale(tree, 0.5)
+        assert bool(finite)
+        np.testing.assert_allclose(out["t0"], np.asarray(tree["t0"]) * 0.5, rtol=1e-6)
+
+    def test_scale_detects_nan_in_any_tensor(self):
+        rng = np.random.default_rng(2)
+        tree = rand_tree(rng, n_tensors=40)
+        tree["t17"] = tree["t17"].at[0].set(jnp.nan)
+        _, finite = mt.multi_tensor_scale(tree, 1.0)
+        assert not bool(finite)
+
+    def test_scale_detects_inf_via_overflow(self):
+        tree = {"a": jnp.asarray([3e38], jnp.float32)}
+        _, finite = mt.multi_tensor_scale(tree, 10.0)  # overflows to inf
+        assert not bool(finite)
+
+    def test_axpby(self):
+        x = {"a": jnp.asarray([1.0, 2.0])}
+        y = {"a": jnp.asarray([10.0, 20.0])}
+        out, finite = mt.multi_tensor_axpby(x, y, 2.0, 0.5)
+        np.testing.assert_allclose(out["a"], [7.0, 14.0])
+        assert bool(finite)
+
+    def test_l2norm_global_and_per_tensor(self):
+        rng = np.random.default_rng(3)
+        tree = rand_tree(rng, n_tensors=8)
+        total, per = mt.multi_tensor_l2norm(tree, per_tensor=True)
+        ref_per = [np.linalg.norm(np.asarray(v)) for v in tree.values()]
+        ref_total = np.sqrt(sum(r**2 for r in ref_per))
+        np.testing.assert_allclose(total, ref_total, rtol=1e-5)
+        np.testing.assert_allclose(per, ref_per, rtol=1e-5)
+
+    def test_segment_l2norms_match_per_tensor(self):
+        rng = np.random.default_rng(4)
+        tree = rand_tree(rng, n_tensors=6)
+        flat, schema = mt.flatten(tree)
+        seg = mt.segment_l2norms(flat, schema)
+        _, per = mt.multi_tensor_l2norm(tree, per_tensor=True)
+        np.testing.assert_allclose(seg, per, rtol=1e-5)
+
+    def test_clip_grad_norm(self):
+        tree = {"a": jnp.asarray([3.0, 4.0])}  # norm 5
+        clipped, norm = mt.clip_grad_norm(tree, 1.0)
+        np.testing.assert_allclose(norm, 5.0, rtol=1e-5)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(clipped["a"])), 1.0, rtol=1e-4
+        )
+        # under the max: untouched
+        clipped, _ = mt.clip_grad_norm(tree, 10.0)
+        np.testing.assert_allclose(clipped["a"], [3.0, 4.0], rtol=1e-5)

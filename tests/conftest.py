@@ -1,0 +1,17 @@
+"""Test harness: force an 8-device CPU mesh before jax initialises.
+
+The reference tests multi-GPU behavior only with real GPUs under a launcher
+(SURVEY.md §4); JAX lets the whole "distributed" tier run on emulated host
+devices, so every test here — including 8-way data/tensor/pipeline-parallel
+tests — runs on CPU in CI.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
