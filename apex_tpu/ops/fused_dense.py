@@ -1,0 +1,109 @@
+"""Fused dense (GEMM + bias [+ GELU]) layers.
+
+TPU-native re-design of ``apex.fused_dense``
+(reference apex/fused_dense/fused_dense.py:6-85, kernels
+csrc/fused_dense.cpp:187-190 + csrc/fused_dense_cuda.cu, which route the
+bias/GELU epilogues through cuBLASLt).
+
+On TPU the MXU + XLA epilogue fusion subsume cuBLASLt epilogues: a matmul
+followed by bias-add/GELU compiles to one fused HLO computation, so these
+functions are thin, API-parity wrappers whose value is (a) the exact
+reference contract (weight stored [out, in], GELU applied between the two
+GEMMs of ``FusedDenseGeluDense``) and (b) bf16-friendly dtype handling with
+fp32 accumulation (``preferred_element_type``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def fused_dense(x: jnp.ndarray, weight: jnp.ndarray,
+                bias: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """``fused_dense_function`` (reference fused_dense.py:66): y = x @ W.T + b.
+
+    ``weight`` is [out_features, in_features] (torch Linear layout, kept for
+    checkpoint parity); accumulation is fp32 on the MXU.
+    """
+    y = jax.lax.dot_general(
+        x, weight,
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def fused_dense_gelu_dense(
+    x: jnp.ndarray,
+    weight1: jnp.ndarray, bias1: Optional[jnp.ndarray],
+    weight2: jnp.ndarray, bias2: Optional[jnp.ndarray],
+) -> jnp.ndarray:
+    """``fused_dense_gelu_dense_function`` (reference fused_dense.py:79):
+    linear → GELU(tanh) → linear as one fused sequence.  The reference saves
+    ``gelu_in`` and the gelu output for its fused backward
+    (fused_dense_cuda.cu bgradb paths); here XLA rematerialises/fuses the
+    same chain automatically under ``jax.grad``."""
+    h = fused_dense(x, weight1, bias1)
+    h = jax.nn.gelu(h, approximate=True)
+    return fused_dense(h, weight2, bias2)
+
+
+class FusedDense:
+    """Module wrapper mirroring ``apex.fused_dense.FusedDense``
+    (reference fused_dense.py:25-45)."""
+
+    def __init__(self, in_features: int, out_features: int, bias: bool = True):
+        self.in_features = in_features
+        self.out_features = out_features
+        self.use_bias = bias
+
+    def init(self, key, dtype=jnp.float32):
+        bound = 1.0 / jnp.sqrt(self.in_features)
+        wkey, bkey = jax.random.split(key)
+        params = {
+            "weight": jax.random.uniform(
+                wkey, (self.out_features, self.in_features), dtype, -bound, bound
+            )
+        }
+        if self.use_bias:
+            params["bias"] = jax.random.uniform(
+                bkey, (self.out_features,), dtype, -bound, bound
+            )
+        return params
+
+    def apply(self, params, x):
+        return fused_dense(x, params["weight"], params.get("bias"))
+
+    __call__ = apply
+
+
+class FusedDenseGeluDense:
+    """Module wrapper mirroring ``FusedDenseGeluDense``
+    (reference fused_dense.py:48-63)."""
+
+    def __init__(self, in_features: int, intermediate_features: int,
+                 out_features: int, bias: bool = True):
+        self.in_features = in_features
+        self.intermediate_features = intermediate_features
+        self.out_features = out_features
+        self.use_bias = bias
+
+    def init(self, key, dtype=jnp.float32):
+        k1, k2 = jax.random.split(key)
+        d1 = FusedDense(self.in_features, self.intermediate_features, self.use_bias)
+        d2 = FusedDense(self.intermediate_features, self.out_features, self.use_bias)
+        return {"dense1": d1.init(k1, dtype), "dense2": d2.init(k2, dtype)}
+
+    def apply(self, params, x):
+        return fused_dense_gelu_dense(
+            x,
+            params["dense1"]["weight"], params["dense1"].get("bias"),
+            params["dense2"]["weight"], params["dense2"].get("bias"),
+        )
+
+    __call__ = apply

@@ -1,0 +1,253 @@
+"""Fused LayerNorm / RMSNorm.
+
+TPU-native re-design of the reference's fused layer-norm stack:
+
+* ``FusedLayerNorm`` / ``MixedFusedLayerNorm``
+  (reference apex/normalization/fused_layer_norm.py:15-218) backed by
+  ``fused_layer_norm_cuda`` (csrc/layer_norm_cuda_kernel.cu:684 forward,
+  :791 backward), and
+* the hidden-size-templated contrib ``FastLayerNorm``
+  (reference apex/contrib/layer_norm/layer_norm.py:8-77, csrc/layer_norm/).
+
+Design: one ``jax.custom_vjp`` function computes statistics in fp32
+(matching the reference's welford accumulation in float), saves
+``(mean, invvar)`` for the backward — exactly the residuals the CUDA
+kernel returns — and runs a fused backward producing
+``(dx, dgamma, dbeta)`` in one pass.  On TPU the forward row-reduction
+runs as a Pallas kernel over (rows, hidden) blocks; elsewhere a pure-XLA
+path is used (XLA fuses the same ops; the Pallas kernel exists to pin the
+layout and avoid HBM round-trips for the stats on large rows).
+
+"Mixed" dtypes (Megatron ``MixedFusedLayerNorm``): the output dtype follows
+the *input*, statistics and parameter math stay fp32 — mirroring the
+"mixed dtypes" instantiation in csrc/layer_norm_cuda.cpp:260-265.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from apex_tpu.ops._pallas import LANE, use_interpret
+
+try:  # pltpu only resolves on TPU builds; interpret mode needs no memory spaces
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+except Exception:  # pragma: no cover
+    pltpu = None
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel: per-row mean/invvar + normalize, stats in fp32.
+# ---------------------------------------------------------------------------
+
+
+def _ln_fwd_kernel(x_ref, w_ref, b_ref, y_ref, mean_ref, invvar_ref, *, eps, n_cols):
+    x = x_ref[...].astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    invvar = jax.lax.rsqrt(var + eps)
+    y = xc * invvar
+    if w_ref is not None:
+        y = y * w_ref[...].astype(jnp.float32)[None, :]
+    if b_ref is not None:
+        y = y + b_ref[...].astype(jnp.float32)[None, :]
+    y_ref[...] = y.astype(y_ref.dtype)
+    mean_ref[...] = mean[:, 0]
+    invvar_ref[...] = invvar[:, 0]
+
+
+def _pallas_ln_fwd(x2d, weight, bias, eps):
+    rows, cols = x2d.shape
+    block_rows = max(1, min(rows, 2048 * LANE // max(cols, LANE)))
+    grid = (rows + block_rows - 1) // block_rows
+    has_w, has_b = weight is not None, bias is not None
+
+    def kernel(*refs):
+        i = 0
+        x_ref = refs[i]; i += 1
+        w_ref = refs[i] if has_w else None; i += has_w
+        b_ref = refs[i] if has_b else None; i += has_b
+        _ln_fwd_kernel(x_ref, w_ref, b_ref, *refs[i:], eps=eps, n_cols=cols)
+
+    in_specs = [pl.BlockSpec((block_rows, cols), lambda i: (i, 0))]
+    args = [x2d]
+    if has_w:
+        in_specs.append(pl.BlockSpec((cols,), lambda i: (0,)))
+        args.append(weight)
+    if has_b:
+        in_specs.append(pl.BlockSpec((cols,), lambda i: (0,)))
+        args.append(bias)
+    y, mean, invvar = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((block_rows, cols), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows,), lambda i: (i,)),
+            pl.BlockSpec((block_rows,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, cols), x2d.dtype),
+            jax.ShapeDtypeStruct((rows,), jnp.float32),
+            jax.ShapeDtypeStruct((rows,), jnp.float32),
+        ],
+        interpret=use_interpret(),
+    )(*args)
+    return y, mean, invvar
+
+
+def _xla_ln_fwd(x2d, weight, bias, eps):
+    x = x2d.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1)
+    xc = x - mean[:, None]
+    var = jnp.mean(xc * xc, axis=-1)
+    invvar = jax.lax.rsqrt(var + eps)
+    y = xc * invvar[:, None]
+    if weight is not None:
+        y = y * weight.astype(jnp.float32)[None, :]
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)[None, :]
+    return y.astype(x2d.dtype), mean, invvar
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _layer_norm(x2d, weight, bias, eps, use_pallas):
+    y, _, _ = (_pallas_ln_fwd if use_pallas else _xla_ln_fwd)(x2d, weight, bias, eps)
+    return y
+
+
+def _layer_norm_fwd(x2d, weight, bias, eps, use_pallas):
+    y, mean, invvar = (_pallas_ln_fwd if use_pallas else _xla_ln_fwd)(
+        x2d, weight, bias, eps
+    )
+    return y, (x2d, weight, bias, mean, invvar)
+
+
+def _layer_norm_bwd(eps, use_pallas, res, dy):
+    # Fused dgrad+dgamma+dbeta, the cuda_layer_norm_gradient contract
+    # (csrc/layer_norm_cuda_kernel.cu:791): everything in fp32, one pass.
+    x2d, weight, bias, mean, invvar = res
+    x = x2d.astype(jnp.float32)
+    g = dy.astype(jnp.float32)
+    xhat = (x - mean[:, None]) * invvar[:, None]
+    if weight is not None:
+        gw = g * weight.astype(jnp.float32)[None, :]
+    else:
+        gw = g
+    n = x.shape[-1]
+    c1 = jnp.mean(gw, axis=-1, keepdims=True)
+    c2 = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+    dx = (gw - c1 - xhat * c2) * invvar[:, None]
+    dx = dx.astype(x2d.dtype)
+    dw = jnp.sum(g * xhat, axis=0).astype(weight.dtype) if weight is not None else None
+    db = jnp.sum(g, axis=0).astype(bias.dtype) if bias is not None else None
+    return dx, dw, db
+
+
+_layer_norm.defvjp(_layer_norm_fwd, _layer_norm_bwd)
+
+
+def _normalized_size(normalized_shape) -> Tuple[int, ...]:
+    if isinstance(normalized_shape, int):
+        return (normalized_shape,)
+    return tuple(normalized_shape)
+
+
+def layer_norm(
+    x: jnp.ndarray,
+    weight: Optional[jnp.ndarray] = None,
+    bias: Optional[jnp.ndarray] = None,
+    *,
+    eps: float = 1e-5,
+    use_pallas: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Fused layer norm over the trailing dims covered by ``weight``.
+
+    Functional equivalent of ``FusedLayerNormAffineFunction.apply``
+    (reference apex/normalization/fused_layer_norm.py:15-40).  Statistics are
+    fp32; output dtype follows the input (the MixedFused semantics — for
+    strict ``FusedLayerNorm`` parity cast inputs to the param dtype first).
+    """
+    norm_ndim = weight.ndim if weight is not None else 1
+    norm_shape = x.shape[-norm_ndim:]
+    cols = int(np.prod(norm_shape))
+    rows = int(np.prod(x.shape)) // cols
+    x2d = x.reshape(rows, cols)
+    w = weight.reshape(cols) if weight is not None else None
+    b = bias.reshape(cols) if bias is not None else None
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    y = _layer_norm(x2d, w, b, float(eps), bool(use_pallas))
+    return y.reshape(x.shape)
+
+
+def rms_norm(
+    x: jnp.ndarray,
+    weight: Optional[jnp.ndarray] = None,
+    *,
+    eps: float = 1e-5,
+) -> jnp.ndarray:
+    """Fused RMSNorm companion (no reference analog in the 2021 tree; provided
+    for the same call sites modern apex serves with ``FusedRMSNorm``)."""
+    norm_ndim = weight.ndim if weight is not None else 1
+    cols = int(np.prod(x.shape[-norm_ndim:]))
+    x2d = x.reshape(-1, cols).astype(jnp.float32)
+    invvar = jax.lax.rsqrt(jnp.mean(x2d * x2d, axis=-1, keepdims=True) + eps)
+    y = x2d * invvar
+    if weight is not None:
+        y = y * weight.reshape(cols).astype(jnp.float32)[None, :]
+    return y.astype(x.dtype).reshape(x.shape)
+
+
+class FusedLayerNorm:
+    """Module-style wrapper mirroring ``apex.normalization.FusedLayerNorm``
+    (reference fused_layer_norm.py:102-186).
+
+    Holds only static config; parameters live in the pytree returned by
+    :meth:`init` and are passed to :meth:`apply` — the functional idiom that
+    replaces the reference's stateful ``nn.Module``.
+    """
+
+    def __init__(self, normalized_shape, eps: float = 1e-5,
+                 elementwise_affine: bool = True):
+        self.normalized_shape = _normalized_size(normalized_shape)
+        self.eps = eps
+        self.elementwise_affine = elementwise_affine
+
+    def init(self, dtype=jnp.float32):
+        if not self.elementwise_affine:
+            return {}
+        return {
+            "weight": jnp.ones(self.normalized_shape, dtype),
+            "bias": jnp.zeros(self.normalized_shape, dtype),
+        }
+
+    def apply(self, params, x):
+        return layer_norm(
+            x, params.get("weight"), params.get("bias"), eps=self.eps
+        )
+
+    __call__ = apply
+
+
+class MixedFusedLayerNorm(FusedLayerNorm):
+    """Megatron variant: stats fp32, output follows input dtype (reference
+    fused_layer_norm.py:189-218).  Identical here — mixed is the default."""
+
+
+# contrib fast_layer_norm (apex/contrib/layer_norm/layer_norm.py:40) is the
+# same computation restricted to supported hidden sizes; on TPU one kernel
+# covers every size, so FastLayerNorm is an alias.
+FastLayerNorm = FusedLayerNorm
+fast_layer_norm = layer_norm

@@ -1,0 +1,91 @@
+"""Fused multi-layer MLP.
+
+TPU-native re-design of ``apex.mlp.MLP``
+(reference apex/mlp/mlp.py:8-79, kernels csrc/mlp.cpp:163-164 +
+csrc/mlp_cuda.cu — N chained cuBLAS GEMMs with fused bias+activation
+epilogues presented to autograd as a single node).
+
+On TPU the "single autograd node over N layers" property is what
+``jax.checkpoint`` + XLA fusion give for free: the whole stack below is one
+jitted computation, bias/activation epilogues fuse into the GEMMs, and the
+backward re-uses saved activations exactly as the reference's
+``mlp_backward`` does.  Weight layout is [out, in] per layer (torch parity);
+accumulation fp32.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.fused_dense import fused_dense
+
+_ACTIVATIONS = {
+    "none": lambda x: x,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+}
+
+
+def mlp(x: jnp.ndarray, weights: Sequence[jnp.ndarray],
+        biases: Optional[Sequence[Optional[jnp.ndarray]]] = None,
+        activation: str = "relu") -> jnp.ndarray:
+    """Functional fused MLP (reference ``mlp_function``, mlp.py:24: note it is
+    registered as an amp ``half_function`` — here dtype follows the input).
+
+    Activation is applied after every layer except the last, matching
+    ``MlpFunction``/mlp_cuda (reference mlp.py:8-21, csrc/mlp_cuda.cu).
+    """
+    act = _ACTIVATIONS[activation]
+    if biases is None:
+        biases = [None] * len(weights)
+    h = x
+    last = len(weights) - 1
+    for i, (w, b) in enumerate(zip(weights, biases)):
+        h = fused_dense(h, w, b)
+        if i != last:
+            h = act(h)
+    return h
+
+
+class MLP:
+    """Module wrapper mirroring ``apex.mlp.MLP`` (reference mlp.py:26-79):
+    ``MLP([in, h1, ..., out], bias=True, activation='relu')``."""
+
+    def __init__(self, mlp_sizes: Sequence[int], bias: bool = True,
+                 activation: str = "relu"):
+        if len(mlp_sizes) < 2:
+            raise ValueError("mlp_sizes needs at least 2 entries")
+        if activation not in _ACTIVATIONS:
+            raise ValueError(f"activation must be one of {list(_ACTIVATIONS)}")
+        self.mlp_sizes = list(mlp_sizes)
+        self.use_bias = bias
+        self.activation = activation
+
+    def init(self, key, dtype=jnp.float32):
+        """Weight init matches reference ``reset_parameters`` (mlp.py:59-66):
+        uniform ±1/sqrt(fan_in) for both weight and bias."""
+        params: List[dict] = []
+        for i in range(len(self.mlp_sizes) - 1):
+            fan_in, fan_out = self.mlp_sizes[i], self.mlp_sizes[i + 1]
+            key, wk, bk = jax.random.split(key, 3)
+            bound = 1.0 / jnp.sqrt(fan_in)
+            layer = {"weight": jax.random.uniform(wk, (fan_out, fan_in), dtype,
+                                                  -bound, bound)}
+            if self.use_bias:
+                layer["bias"] = jax.random.uniform(bk, (fan_out,), dtype,
+                                                   -bound, bound)
+            params.append(layer)
+        return params
+
+    def apply(self, params, x):
+        return mlp(
+            x,
+            [p["weight"] for p in params],
+            [p.get("bias") for p in params],
+            self.activation,
+        )
+
+    __call__ = apply

@@ -1,0 +1,94 @@
+"""Fused softmax cross-entropy with label smoothing.
+
+TPU-native re-design of ``apex.contrib.xentropy.SoftmaxCrossEntropyLoss``
+(reference apex/contrib/xentropy/softmax_xentropy.py:4-28, kernel
+csrc/xentropy/xentropy_kernel.cu:718).
+
+The reference fuses log-sum-exp, the label gather, and label smoothing into
+one kernel, returns per-example ``losses`` plus the saved
+``max_log_sum_exp`` residual, and implements the smoothed backward in a
+second kernel.  Same contract here via ``jax.custom_vjp``: forward saves
+(max + log-sum-exp); backward is the closed-form smoothed softmax gradient,
+scaled by the incoming cotangent (the kernel's ``grad_output`` multiply).
+``half_to_float=True`` makes the loss fp32 for half inputs (reference
+softmax_xentropy.py:16).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _lse(logits32):
+    m = jnp.max(logits32, axis=-1)
+    return m + jnp.log(jnp.sum(jnp.exp(logits32 - m[..., None]), axis=-1))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def softmax_cross_entropy_loss(logits, labels, smoothing=0.0, half_to_float=False):
+    """Per-example smoothed CE. ``logits`` [N, C], ``labels`` int [N].
+
+    loss_i = (1-s)·(lse_i - z_i[y_i]) + s·(lse_i - mean_j z_ij)
+    which matches the reference's label-smoothing formulation
+    (xentropy_kernel.cu: smoothing splits weight between the target and the
+    uniform distribution).
+    """
+    loss, _ = _xent_fwd_math(logits, labels, smoothing)
+    if not half_to_float:
+        loss = loss.astype(logits.dtype)
+    return loss
+
+
+def _xent_fwd_math(logits, labels, smoothing):
+    z = logits.astype(jnp.float32)
+    lse = _lse(z)
+    target_z = jnp.take_along_axis(z, labels[..., None], axis=-1)[..., 0]
+    if smoothing:
+        mean_z = jnp.mean(z, axis=-1)
+        loss = lse - (1.0 - smoothing) * target_z - smoothing * mean_z
+    else:
+        loss = lse - target_z
+    return loss, lse
+
+
+def _xent_fwd(logits, labels, smoothing, half_to_float):
+    loss, lse = _xent_fwd_math(logits, labels, smoothing)
+    if not half_to_float:
+        loss = loss.astype(logits.dtype)
+    return loss, (logits, labels, lse)
+
+
+def _xent_bwd(smoothing, half_to_float, res, g):
+    logits, labels, lse = res
+    z = logits.astype(jnp.float32)
+    probs = jnp.exp(z - lse[..., None])
+    onehot = jax.nn.one_hot(labels, z.shape[-1], dtype=jnp.float32)
+    if smoothing:
+        target = (1.0 - smoothing) * onehot + smoothing / z.shape[-1]
+    else:
+        target = onehot
+    dlogits = (probs - target) * g.astype(jnp.float32)[..., None]
+    return dlogits.astype(logits.dtype), None
+
+
+softmax_cross_entropy_loss.defvjp(_xent_fwd, _xent_bwd)
+
+
+class SoftmaxCrossEntropyLoss:
+    """Class-style wrapper mirroring the reference module
+    (softmax_xentropy.py:4): ``loss = SoftmaxCrossEntropyLoss()(logits,
+    labels, smoothing)``, returns per-example losses (caller reduces)."""
+
+    @staticmethod
+    def apply(logits, labels, smoothing: float = 0.0,
+              padding_idx: int = 0, half_to_float: bool = False):
+        del padding_idx  # reference accepts but only supports 0 (assert :19)
+        return softmax_cross_entropy_loss(logits, labels, smoothing, half_to_float)
+
+    def __call__(self, logits, labels, smoothing: float = 0.0,
+                 half_to_float: bool = False):
+        return softmax_cross_entropy_loss(logits, labels, smoothing, half_to_float)

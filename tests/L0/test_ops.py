@@ -1,0 +1,231 @@
+"""L0 fused-op tests vs unfused jnp references.
+
+Mirrors the reference's kernel-vs-reference tier (SURVEY.md §4):
+tests/L0/run_fused_layer_norm/test_fused_layer_norm.py,
+run_transformer/test_fused_softmax.py, run_mlp/test_mlp.py,
+contrib xentropy tests.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import ops
+
+
+def _ln_ref(x, w, b, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(-1, keepdims=True)
+    var = ((x32 - mean) ** 2).mean(-1, keepdims=True)
+    y = (x32 - mean) / jnp.sqrt(var + eps)
+    if w is not None:
+        y = y * w
+    if b is not None:
+        y = y + b
+    return y.astype(x.dtype)
+
+
+class TestFusedLayerNorm:
+    def test_fwd_matches_reference(self):
+        key = jax.random.PRNGKey(0)
+        x = jax.random.normal(key, (12, 256), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(1), (256,)) * 0.1 + 1.0
+        b = jax.random.normal(jax.random.PRNGKey(2), (256,)) * 0.1
+        np.testing.assert_allclose(
+            ops.layer_norm(x, w, b), _ln_ref(x, w, b), rtol=1e-5, atol=1e-5
+        )
+
+    def test_fwd_no_affine(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 64))
+        np.testing.assert_allclose(
+            ops.layer_norm(x), _ln_ref(x, None, None), rtol=1e-5, atol=1e-5
+        )
+
+    def test_grad_matches_reference(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 128), jnp.float32)
+        w = jnp.ones((128,)) * 1.3
+        b = jnp.zeros((128,)) + 0.1
+
+        def loss_fused(x, w, b):
+            return jnp.sum(jnp.sin(ops.layer_norm(x, w, b)))
+
+        def loss_ref(x, w, b):
+            return jnp.sum(jnp.sin(_ln_ref(x, w, b)))
+
+        g1 = jax.grad(loss_fused, argnums=(0, 1, 2))(x, w, b)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(x, w, b)
+        for a, r in zip(g1, g2):
+            np.testing.assert_allclose(a, r, rtol=1e-4, atol=1e-4)
+
+    def test_pallas_interpret_matches_xla(self):
+        x = jax.random.normal(jax.random.PRNGKey(3), (16, 128), jnp.float32)
+        w = jnp.full((128,), 1.1)
+        b = jnp.full((128,), -0.2)
+        np.testing.assert_allclose(
+            ops.layer_norm(x, w, b, use_pallas=True),
+            ops.layer_norm(x, w, b, use_pallas=False),
+            rtol=1e-6, atol=1e-6,
+        )
+
+    def test_bf16_output_dtype_follows_input(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 128)).astype(jnp.bfloat16)
+        w = jnp.ones((128,), jnp.float32)
+        b = jnp.zeros((128,), jnp.float32)
+        y = ops.layer_norm(x, w, b)
+        assert y.dtype == jnp.bfloat16
+
+    def test_module_wrapper(self):
+        m = ops.FusedLayerNorm(64)
+        params = m.init()
+        x = jax.random.normal(jax.random.PRNGKey(0), (3, 5, 64))
+        y = m.apply(params, x)
+        assert y.shape == x.shape
+        np.testing.assert_allclose(
+            y, _ln_ref(x, params["weight"], params["bias"]), rtol=1e-5, atol=1e-5
+        )
+
+    def test_rms_norm(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 128))
+        w = jnp.full((128,), 2.0)
+        ref = x / jnp.sqrt((x**2).mean(-1, keepdims=True) + 1e-5) * 2.0
+        np.testing.assert_allclose(ops.rms_norm(x, w), ref, rtol=1e-5, atol=1e-5)
+
+
+class TestFusedSoftmax:
+    def test_masked_matches_reference(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 8, 16))
+        mask = jax.random.bernoulli(jax.random.PRNGKey(1), 0.3, (2, 1, 8, 16))
+        scale = 0.5
+        ref = jax.nn.softmax(jnp.where(mask, -10000.0, x * scale), axis=-1)
+        np.testing.assert_allclose(
+            ops.scaled_masked_softmax(x, mask, scale), ref, rtol=1e-5, atol=1e-6
+        )
+
+    def test_causal_matches_reference(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 2, 16, 16))
+        tri = jnp.tril(jnp.ones((16, 16), bool))
+        ref = jax.nn.softmax(jnp.where(tri, x * 2.0, -10000.0), axis=-1)
+        np.testing.assert_allclose(
+            ops.scaled_upper_triang_masked_softmax(x, 2.0), ref, rtol=1e-5, atol=1e-6
+        )
+
+    def test_grad_matches_reference(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 2, 8, 8))
+
+        def f_fused(x):
+            return jnp.sum(ops.scaled_upper_triang_masked_softmax(x, 1.7) ** 2)
+
+        def f_ref(x):
+            tri = jnp.tril(jnp.ones((8, 8), bool))
+            return jnp.sum(jax.nn.softmax(jnp.where(tri, x * 1.7, -10000.0), -1) ** 2)
+
+        np.testing.assert_allclose(
+            jax.grad(f_fused)(x), jax.grad(f_ref)(x), rtol=1e-4, atol=1e-5
+        )
+
+    def test_wrapper_fused_vs_unfused(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 8, 8)).astype(jnp.bfloat16)
+        mask = jax.random.bernoulli(jax.random.PRNGKey(1), 0.2, (2, 1, 8, 8))
+        fused = ops.FusedScaleMaskSoftmax(
+            input_in_bf16=True, scaled_masked_softmax_fusion=True)
+        unfused = ops.FusedScaleMaskSoftmax(
+            input_in_bf16=True, scaled_masked_softmax_fusion=False)
+        np.testing.assert_allclose(
+            np.asarray(fused(x, mask), np.float32),
+            np.asarray(unfused(x, mask), np.float32), rtol=1e-2, atol=1e-2)
+
+    def test_wrapper_scale_requires_fp32(self):
+        with pytest.raises(ValueError):
+            ops.FusedScaleMaskSoftmax(softmax_in_fp32=False, scale=2.0)
+
+
+class TestXentropy:
+    def test_matches_reference_no_smoothing(self):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (16, 100))
+        labels = jax.random.randint(jax.random.PRNGKey(1), (16,), 0, 100)
+        ref = -jax.nn.log_softmax(logits)[jnp.arange(16), labels]
+        np.testing.assert_allclose(
+            ops.softmax_cross_entropy_loss(logits, labels), ref, rtol=1e-5, atol=1e-5
+        )
+
+    def test_matches_reference_smoothing(self):
+        s = 0.1
+        logits = jax.random.normal(jax.random.PRNGKey(0), (8, 50))
+        labels = jax.random.randint(jax.random.PRNGKey(1), (8,), 0, 50)
+        logp = jax.nn.log_softmax(logits)
+        onehot = jax.nn.one_hot(labels, 50)
+        target = (1 - s) * onehot + s / 50
+        ref = -(target * logp).sum(-1)
+        np.testing.assert_allclose(
+            ops.softmax_cross_entropy_loss(logits, labels, s), ref,
+            rtol=1e-5, atol=1e-5)
+
+    def test_grad_matches_reference(self):
+        s = 0.2
+        logits = jax.random.normal(jax.random.PRNGKey(0), (8, 30))
+        labels = jax.random.randint(jax.random.PRNGKey(1), (8,), 0, 30)
+
+        def f_fused(z):
+            return ops.softmax_cross_entropy_loss(z, labels, s).mean()
+
+        def f_ref(z):
+            logp = jax.nn.log_softmax(z)
+            target = (1 - s) * jax.nn.one_hot(labels, 30) + s / 30
+            return -(target * logp).sum(-1).mean()
+
+        np.testing.assert_allclose(
+            jax.grad(f_fused)(logits), jax.grad(f_ref)(logits), rtol=1e-4, atol=1e-6
+        )
+
+    def test_half_to_float(self):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (4, 10)).astype(jnp.bfloat16)
+        labels = jnp.array([1, 2, 3, 4])
+        assert ops.softmax_cross_entropy_loss(
+            logits, labels, 0.0, True).dtype == jnp.float32
+        assert ops.softmax_cross_entropy_loss(
+            logits, labels, 0.0, False).dtype == jnp.bfloat16
+
+
+class TestDenseAndMLP:
+    def test_fused_dense(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 32))
+        layer = ops.FusedDense(32, 16)
+        p = layer.init(jax.random.PRNGKey(1))
+        np.testing.assert_allclose(
+            layer.apply(p, x), x @ p["weight"].T + p["bias"], rtol=1e-5, atol=1e-5
+        )
+
+    def test_fused_dense_gelu_dense(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 16))
+        layer = ops.FusedDenseGeluDense(16, 64, 16)
+        p = layer.init(jax.random.PRNGKey(1))
+        h = x @ p["dense1"]["weight"].T + p["dense1"]["bias"]
+        ref = jax.nn.gelu(h, approximate=True) @ p["dense2"]["weight"].T + p["dense2"]["bias"]
+        np.testing.assert_allclose(layer.apply(p, x), ref, rtol=1e-5, atol=1e-5)
+
+    def test_mlp_matches_linear_stack(self):
+        # reference tests/L0/run_mlp/test_mlp.py: MLP vs nn.Linear sequence
+        sizes = [40, 30, 20, 10]
+        m = ops.MLP(sizes, activation="relu")
+        p = m.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 40))
+        h = x
+        for i, layer in enumerate(p):
+            h = h @ layer["weight"].T + layer["bias"]
+            if i != len(p) - 1:
+                h = jax.nn.relu(h)
+        np.testing.assert_allclose(m.apply(p, x), h, rtol=1e-5, atol=1e-5)
+
+    def test_mlp_grads(self):
+        m = ops.MLP([16, 16, 4], bias=True, activation="sigmoid")
+        p = m.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 16))
+
+        def loss(p):
+            return jnp.sum(m.apply(p, x) ** 2)
+
+        g = jax.grad(loss)(p)
+        leaves = jax.tree_util.tree_leaves(g)
+        assert all(bool(jnp.all(jnp.isfinite(l))) for l in leaves)
+        assert max(float(jnp.abs(l).max()) for l in leaves) > 0
