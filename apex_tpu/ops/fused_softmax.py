@@ -36,14 +36,18 @@ class AttnMaskType(Enum):
     causal = 2
 
 
-def _softmax_fwd_math(x, mask, scale, causal):
-    x = x.astype(jnp.float32) * scale
+def _apply_masks(x, mask, causal):
     if causal:
         sq, sk = x.shape[-2], x.shape[-1]
         tri = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         x = jnp.where(tri, x, MASK_FILL)
     if mask is not None:
         x = jnp.where(mask, MASK_FILL, x)
+    return x
+
+
+def _softmax_fwd_math(x, mask, scale, causal):
+    x = _apply_masks(x.astype(jnp.float32) * scale, mask, causal)
     x = x - jax.lax.stop_gradient(jnp.max(x, axis=-1, keepdims=True))
     ex = jnp.exp(x)
     return ex / jnp.sum(ex, axis=-1, keepdims=True)
@@ -55,13 +59,16 @@ def _fused_softmax(x, mask, scale, causal):
 
 
 def _fused_softmax_fwd(x, mask, scale, causal):
-    y32 = _softmax_fwd_math(x, mask, scale, causal)
-    y = y32.astype(x.dtype)
-    return y, (y32,)
+    y = _softmax_fwd_math(x, mask, scale, causal).astype(x.dtype)
+    # residual kept in *input* dtype — the reference backward consumes the
+    # half-precision softmax_results tensor (scaled_masked_softmax.h bwd);
+    # an fp32 copy would double activation memory for the largest tensor.
+    return y, (y,)
 
 
 def _fused_softmax_bwd(scale, causal, res, dy):
-    (y32,) = res
+    (y,) = res
+    y32 = y.astype(jnp.float32)
     g = dy.astype(jnp.float32)
     dx = (g - jnp.sum(g * y32, axis=-1, keepdims=True)) * y32 * scale
     return dx.astype(dy.dtype), None
@@ -131,15 +138,15 @@ class FusedScaleMaskSoftmax:
         # unfused parity path (reference forward_torch_softmax :173-186)
         xs = x.astype(jnp.float32) if self.softmax_in_fp32 else x
         xs = xs * scale
-        if self.attn_mask_type == AttnMaskType.causal:
-            sq, sk = xs.shape[-2], xs.shape[-1]
-            tri = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-            xs = jnp.where(tri, xs, MASK_FILL)
-        if mask is not None:
-            xs = (self.mask_func(xs, mask) if self.mask_func is not None
-                  else jnp.where(mask, MASK_FILL, xs))
+        causal = self.attn_mask_type == AttnMaskType.causal
+        if self.mask_func is not None and mask is not None:
+            xs = self.mask_func(_apply_masks(xs, None, causal), mask)
+        else:
+            xs = _apply_masks(xs, mask, causal)
         probs = jax.nn.softmax(xs, axis=-1)
-        return probs.astype(x.dtype) if self.softmax_in_fp32 else probs
+        if self.softmax_in_fp32 and self.input_in_float16:
+            probs = probs.astype(x.dtype)
+        return probs
 
     @staticmethod
     def is_kernel_available(*_args, **_kw) -> bool:

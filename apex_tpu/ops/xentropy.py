@@ -86,7 +86,9 @@ class SoftmaxCrossEntropyLoss:
     @staticmethod
     def apply(logits, labels, smoothing: float = 0.0,
               padding_idx: int = 0, half_to_float: bool = False):
-        del padding_idx  # reference accepts but only supports 0 (assert :19)
+        if padding_idx != 0:
+            # reference softmax_xentropy.py:19 asserts padding_idx == 0
+            raise ValueError("only padding_idx=0 is supported")
         return softmax_cross_entropy_loss(logits, labels, smoothing, half_to_float)
 
     def __call__(self, logits, labels, smoothing: float = 0.0,

@@ -1,0 +1,23 @@
+"""apex_tpu.parallel — data parallelism over the mesh "data" axis.
+
+TPU-native re-design of ``apex.parallel`` (SURVEY.md §2.4): gradient
+psum with the reference DDP's numerics options, SyncBatchNorm via Welford
+moment combination + psum, LARC (re-exported from optimizers), and the
+multi-host launcher shim.
+"""
+
+from apex_tpu.optimizers.larc import LARC  # noqa: F401
+from apex_tpu.parallel.distributed import (  # noqa: F401
+    DistributedDataParallel,
+    Reducer,
+    all_reduce_grads,
+    broadcast_params,
+)
+from apex_tpu.parallel.multiproc import initialize_distributed  # noqa: F401
+from apex_tpu.parallel.sync_batchnorm import (  # noqa: F401
+    SyncBatchNorm,
+    convert_syncbn_model,
+    create_syncbn_process_group,
+    sync_batch_norm,
+    sync_batch_norm_stats,
+)

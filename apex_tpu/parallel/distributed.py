@@ -1,0 +1,150 @@
+"""Data-parallel gradient reduction.
+
+TPU-native re-design of ``apex.parallel.DistributedDataParallel``
+(reference apex/parallel/distributed.py:129-639) and ``Reducer`` (:89-126).
+
+The reference's machinery — per-parameter autograd hooks, first-iteration
+bucket-structure discovery, flatten→NCCL-allreduce→unflatten on side CUDA
+streams — exists to overlap communication with backward in an eager engine.
+Under jit none of it is needed: data parallelism is a ``lax.psum`` (or
+``pmean``) of the grad pytree over the mesh "data" axis inside the compiled
+step, and XLA's latency-hiding scheduler overlaps the collectives with the
+backward computation automatically.
+
+What *does* carry over is the numerics contract, preserved here exactly:
+
+* ``gradient_average`` → mean vs sum reduction (reference :162,:454-457);
+* ``gradient_predivide_factor`` → divide by f before the reduce, by
+  world/f after (reference :171-175,:442-443,:453-456) for overflow-safe
+  large-world averaging;
+* ``allreduce_always_fp32`` → cast bf16/fp16 grads to fp32 for the reduce,
+  cast back after (reference :166,:445-448,:459-465).
+
+``DistributedDataParallel`` below is a thin callable wrapper so training
+code reads like the reference; ``Reducer`` is its manual-trigger twin.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def all_reduce_grads(
+    grads: Any,
+    axis_name: str = "data",
+    *,
+    gradient_average: bool = True,
+    gradient_predivide_factor: float = 1.0,
+    allreduce_always_fp32: bool = False,
+) -> Any:
+    """Reduce a grad pytree across the mesh ``axis_name`` axis.
+
+    Must be called inside a ``pjit``/``shard_map``/``pmap`` context that
+    binds ``axis_name``.  Semantics table (reference distributed.py:442-468):
+
+    ========================  =============================================
+    gradient_average          divide the summed grads by world size
+    gradient_predivide_factor grads/f before psum, /(world/f) after
+    allreduce_always_fp32     reduce in fp32, cast back to grad dtype
+    ========================  =============================================
+    """
+    world = jax.lax.psum(1, axis_name)
+
+    def reduce_one(g):
+        dtype = g.dtype
+        if allreduce_always_fp32:
+            g = g.astype(jnp.float32)
+        if gradient_predivide_factor != 1.0:
+            g = g / gradient_predivide_factor
+        g = jax.lax.psum(g, axis_name)
+        if gradient_average:
+            post = world / gradient_predivide_factor
+            if gradient_predivide_factor != 1.0:
+                g = g / post
+            else:
+                g = g / world
+        elif gradient_predivide_factor != 1.0:
+            g = g * gradient_predivide_factor
+        return g.astype(dtype)
+
+    return jax.tree_util.tree_map(reduce_one, grads)
+
+
+def broadcast_params(params: Any, axis_name: str = "data") -> Any:
+    """Make parameters bitwise-identical across the data axis — the
+    rank-0 broadcast the reference performs at DDP construction
+    (distributed.py:253-256).  Implemented as an axis-wide mean of already
+    replicated values' rank-0 contribution via ppermute-free select: every
+    device adopts index-0's value."""
+
+    idx = jax.lax.axis_index(axis_name)
+
+    def bcast(p):
+        # masked psum: every device adopts index 0's copy with O(1) extra
+        # memory (an all_gather would transiently cost world× params).
+        return jax.lax.psum(jnp.where(idx == 0, p, jnp.zeros_like(p)), axis_name)
+
+    return jax.tree_util.tree_map(bcast, params)
+
+
+class DistributedDataParallel:
+    """Callable grad-reducer with the reference's constructor surface
+    (distributed.py:162-189).  Options that only exist to manage eager
+    overlap (``message_size``, ``delay_allreduce``, ``num_allreduce_streams``,
+    ``allreduce_trigger_params``, ``prof``) are accepted and ignored — XLA
+    owns scheduling; they are recorded for introspection.
+    """
+
+    def __init__(
+        self,
+        axis_name: str = "data",
+        message_size: int = 10_000_000,
+        delay_allreduce: bool = False,
+        shared_param: Optional[bool] = None,
+        allreduce_trigger_params: Optional[list] = None,
+        retain_allreduce_buffers: bool = False,
+        allreduce_always_fp32: bool = False,
+        num_allreduce_streams: int = 1,
+        allreduce_communicators: Optional[tuple] = None,
+        gradient_average: bool = True,
+        gradient_predivide_factor: float = 1.0,
+        gradient_average_split_factor: Optional[float] = None,
+        prof: bool = False,
+    ):
+        self.axis_name = axis_name
+        self.gradient_average = gradient_average
+        self.gradient_predivide_factor = gradient_predivide_factor
+        self.allreduce_always_fp32 = allreduce_always_fp32
+        # eager-only knobs, kept for API parity:
+        self._ignored = dict(
+            message_size=message_size, delay_allreduce=delay_allreduce,
+            num_allreduce_streams=num_allreduce_streams, prof=prof,
+        )
+
+    def __call__(self, grads: Any) -> Any:
+        return all_reduce_grads(
+            grads,
+            self.axis_name,
+            gradient_average=self.gradient_average,
+            gradient_predivide_factor=self.gradient_predivide_factor,
+            allreduce_always_fp32=self.allreduce_always_fp32,
+        )
+
+    reduce = __call__
+
+
+class Reducer:
+    """Manual allreduce helper (reference distributed.py:89-126): the user
+    calls ``reducer.reduce(grads)`` when ready; no hook magic."""
+
+    def __init__(self, axis_name: str = "data", gradient_average: bool = True):
+        self.axis_name = axis_name
+        self.gradient_average = gradient_average
+
+    def reduce(self, tree: Any) -> Any:
+        return all_reduce_grads(
+            tree, self.axis_name, gradient_average=self.gradient_average
+        )

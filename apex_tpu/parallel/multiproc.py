@@ -1,0 +1,56 @@
+"""Multi-process launcher shim.
+
+The reference ships ``python -m apex.parallel.multiproc`` — a subprocess
+spawner that sets RANK/WORLD_SIZE per GPU (apex/parallel/multiproc.py:1-35).
+On TPU, process-per-host topology is owned by the runtime: inside one host
+all local chips belong to one process, and multi-host jobs call
+``jax.distributed.initialize`` (coordinator address from the scheduler).
+This module keeps the entry point and maps it to that world.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def initialize_distributed(coordinator_address: str | None = None,
+                           num_processes: int | None = None,
+                           process_id: int | None = None) -> None:
+    """Initialise multi-host JAX. No-op for single-process runs.
+
+    Mirrors what torch.distributed.launch env plumbing (+ multiproc.py)
+    achieves for the reference: after this, ``jax.devices()`` spans hosts.
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "COORDINATOR_ADDRESS")
+    if coordinator_address is None:
+        return  # single host: nothing to do
+    if num_processes is None:
+        num_processes = int(os.environ.get("WORLD_SIZE", 1))
+    if process_id is None:
+        process_id = int(os.environ.get("RANK", 0))
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def main() -> None:  # pragma: no cover - exercised manually
+    """``python -m apex_tpu.parallel.multiproc train.py args...`` — run the
+    script after distributed init (reference multiproc.py spawns one process
+    per device; on TPU one process already owns all local devices)."""
+    initialize_distributed()
+    if len(sys.argv) > 1:
+        script = sys.argv[1]
+        sys.argv = sys.argv[1:]
+        with open(script) as f:
+            code = compile(f.read(), script, "exec")
+        exec(code, {"__name__": "__main__", "__file__": script})
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

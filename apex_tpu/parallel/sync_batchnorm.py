@@ -1,0 +1,231 @@
+"""SyncBatchNorm — cross-device batch normalization.
+
+TPU-native re-design of the reference's optimized SyncBN stack:
+
+* ``SyncBatchnormFunction`` (reference
+  apex/parallel/optimized_sync_batchnorm_kernel.py:7-119),
+* module ``SyncBatchNorm`` (optimized_sync_batchnorm.py:9-100),
+* Welford CUDA kernels (csrc/welford.cu: welford_kernel :259,
+  welford_parallel merge, batchnorm_forward :298, reduce_bn,
+  batchnorm_backward) and bindings csrc/syncbn.cpp:99-108.
+
+Algorithm parity (forward):
+  local mean/var  →  combine across the process group  →  normalize.
+The reference allgathers (mean, var, count) per device then runs a
+``welford_parallel`` merge kernel.  Here the merge is the closed-form
+count-weighted moment combination under ``lax.psum`` over the mesh axis —
+numerically the same statistics, one collective, no gather buffer:
+
+  n      = Σ n_i
+  mean   = Σ n_i·mean_i / n
+  E[x²]  = Σ n_i·(var_i + mean_i²) / n
+  var    = E[x²] − mean²
+
+Backward parity: local reduction of (Σdy, Σdy·(x−mean)) → psum → fused
+dgrad (reference kernel.py:93-111, collective at :101-106).  Running stats
+use unbiased variance with the n/(n−1) correction (kernel.py:48-56).
+
+Supports a per-subgroup ``process_group`` as a *named sub-axis* — the
+``create_syncbn_process_group`` pattern (apex/parallel/__init__.py:60-95)
+maps to meshes with a split data axis, e.g. ("data_outer", "data_inner").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+AxisName = Union[str, Sequence[str], None]
+
+
+def _channel_reduce_axes(x: jnp.ndarray, channel_axis: int) -> Tuple[int, ...]:
+    return tuple(i for i in range(x.ndim) if i != channel_axis % x.ndim)
+
+
+def sync_batch_norm_stats(
+    x: jnp.ndarray,
+    axis_name: AxisName,
+    channel_axis: int = -1,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Global (mean, biased var, count) per channel across devices.
+
+    Mirrors welford_mean_var + allgather + welford_parallel
+    (optimized_sync_batchnorm_kernel.py:23-46) via moment combination.
+    """
+    axes = _channel_reduce_axes(x, channel_axis)
+    x32 = x.astype(jnp.float32)
+    local_n = jnp.array(
+        jnp.prod(jnp.array([x.shape[a] for a in axes])), jnp.float32)
+    local_sum = jnp.sum(x32, axis=axes)
+    local_sumsq = jnp.sum(x32 * x32, axis=axes)
+    if axis_name is not None:
+        local_sum = jax.lax.psum(local_sum, axis_name)
+        local_sumsq = jax.lax.psum(local_sumsq, axis_name)
+        local_n = jax.lax.psum(local_n, axis_name)
+    mean = local_sum / local_n
+    var = local_sumsq / local_n - mean * mean
+    return mean, var, local_n
+
+
+def sync_batch_norm(
+    x: jnp.ndarray,
+    weight: Optional[jnp.ndarray],
+    bias: Optional[jnp.ndarray],
+    running_mean: Optional[jnp.ndarray] = None,
+    running_var: Optional[jnp.ndarray] = None,
+    *,
+    axis_name: AxisName = "data",
+    training: bool = True,
+    momentum: float = 0.1,
+    eps: float = 1e-5,
+    channel_axis: int = -1,
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
+    """Functional SyncBN. Returns ``(y, new_running_mean, new_running_var)``.
+
+    In eval mode (``training=False``) running stats normalize the input with
+    no collective, matching module forward at optimized_sync_batchnorm.py:70-85.
+    """
+    if training:
+        mean, var, n = sync_batch_norm_stats(x, axis_name, channel_axis)
+        if running_mean is not None:
+            # unbiased var for running stats — kernel.py:48-56
+            unbiased = var * (n / jnp.maximum(n - 1.0, 1.0))
+            new_rm = (1 - momentum) * running_mean + momentum * mean
+            new_rv = (1 - momentum) * running_var + momentum * unbiased
+        else:
+            new_rm, new_rv = None, None
+    elif running_mean is not None:
+        mean, var = running_mean.astype(jnp.float32), running_var.astype(jnp.float32)
+        new_rm, new_rv = running_mean, running_var
+    else:
+        # eval without tracked stats: fall back to batch statistics, the
+        # torch _BatchNorm contract the reference module inherits.
+        mean, var, _ = sync_batch_norm_stats(x, axis_name, channel_axis)
+        new_rm, new_rv = None, None
+
+    shape = [1] * x.ndim
+    shape[channel_axis % x.ndim] = x.shape[channel_axis % x.ndim]
+    invstd = jax.lax.rsqrt(var + eps)
+    y = (x.astype(jnp.float32) - mean.reshape(shape)) * invstd.reshape(shape)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32).reshape(shape)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32).reshape(shape)
+    return y.astype(x.dtype), new_rm, new_rv
+
+
+class SyncBatchNorm:
+    """Module wrapper mirroring ``apex.parallel.SyncBatchNorm``
+    (optimized_sync_batchnorm.py:9; constructor args from torch
+    ``_BatchNorm`` plus ``process_group`` and ``channel_last``).
+
+    State (running stats) is explicit: :meth:`init` returns
+    ``{"params": ..., "state": ...}``; :meth:`apply` returns
+    ``(y, new_state)`` — the functional version of mutable buffers.
+    ``channel_last=True`` (NHWC, channel_axis=-1) is the TPU-native layout
+    and the default; the reference's NCHW maps to ``channel_axis=1``.
+    """
+
+    def __init__(
+        self,
+        num_features: int,
+        eps: float = 1e-5,
+        momentum: float = 0.1,
+        affine: bool = True,
+        track_running_stats: bool = True,
+        process_group: AxisName = "data",
+        channel_last: bool = True,
+        fuse_relu: bool = False,
+    ):
+        self.num_features = num_features
+        self.eps = eps
+        self.momentum = momentum
+        self.affine = affine
+        self.track_running_stats = track_running_stats
+        self.process_group = process_group
+        self.channel_axis = -1 if channel_last else 1
+        self.fuse_relu = fuse_relu  # groupbn/welford fuse-relu variant
+
+    def init(self, dtype=jnp.float32):
+        params = {}
+        if self.affine:
+            params = {
+                "weight": jnp.ones((self.num_features,), dtype),
+                "bias": jnp.zeros((self.num_features,), dtype),
+            }
+        state = {}
+        if self.track_running_stats:
+            state = {
+                "running_mean": jnp.zeros((self.num_features,), jnp.float32),
+                "running_var": jnp.ones((self.num_features,), jnp.float32),
+            }
+        return {"params": params, "state": state}
+
+    def apply(self, variables, x, *, training: bool = True):
+        params, state = variables["params"], variables["state"]
+        y, rm, rv = sync_batch_norm(
+            x,
+            params.get("weight"),
+            params.get("bias"),
+            state.get("running_mean"),
+            state.get("running_var"),
+            axis_name=self.process_group if training else None,
+            training=training,
+            momentum=self.momentum,
+            eps=self.eps,
+            channel_axis=self.channel_axis,
+        )
+        if self.fuse_relu:
+            y = jax.nn.relu(y)
+        new_state = dict(state)
+        if rm is not None:
+            new_state = {"running_mean": rm, "running_var": rv}
+        return y, {"params": params, "state": new_state}
+
+    __call__ = apply
+
+
+def convert_syncbn_model(module_tree: Any, process_group: AxisName = "data",
+                         channel_last: bool = True) -> Any:
+    """Recursive BN→SyncBN swap (reference apex/parallel/__init__.py:21-57).
+
+    Works over any pytree/structure containing :class:`SyncBatchNorm`-likes
+    or objects exposing ``num_features``: BN-shaped nodes are rebuilt as
+    :class:`SyncBatchNorm` with the given group.  For flax models, prefer
+    constructing with ``apex_tpu.parallel.SyncBatchNorm`` directly — there
+    is no module graph to mutate in functional code, so this helper exists
+    for config-level conversion.
+    """
+    def convert(node):
+        if hasattr(node, "num_features") and not isinstance(node, SyncBatchNorm):
+            return SyncBatchNorm(
+                node.num_features,
+                eps=getattr(node, "eps", 1e-5),
+                momentum=getattr(node, "momentum", 0.1),
+                affine=getattr(node, "affine", True),
+                track_running_stats=getattr(node, "track_running_stats", True),
+                process_group=process_group,
+                channel_last=channel_last,
+            )
+        return node
+
+    if isinstance(module_tree, (list, tuple)):
+        return type(module_tree)(convert_syncbn_model(m, process_group, channel_last)
+                                 for m in module_tree)
+    if isinstance(module_tree, dict):
+        return {k: convert_syncbn_model(v, process_group, channel_last)
+                for k, v in module_tree.items()}
+    return convert(module_tree)
+
+
+def create_syncbn_process_group(group_size: int, world_size: int) -> Tuple[str, ...]:
+    """Reference apex/parallel/__init__.py:60-95 partitions ranks into BN
+    subgroups of ``group_size``.  On a mesh this is a *shape*, not a group
+    object: split the data axis as ("data_outer", "data_bn") with
+    data_bn=group_size and psum over "data_bn" only.  Returns the axis names
+    to use; the caller builds the mesh accordingly."""
+    if group_size <= 0 or world_size % group_size != 0:
+        raise ValueError("group_size must divide world_size")
+    return ("data_outer", "data_bn")

@@ -1,17 +1,26 @@
-"""Test harness: force an 8-device CPU mesh before jax initialises.
+"""Test harness: force an 8-device CPU mesh before any test imports jax.
 
 The reference tests multi-GPU behavior only with real GPUs under a launcher
 (SURVEY.md §4); JAX lets the whole "distributed" tier run on emulated host
 devices, so every test here — including 8-way data/tensor/pipeline-parallel
 tests — runs on CPU in CI.
+
+Note: this environment pre-imports jax at interpreter startup (sitecustomize)
+with ``JAX_PLATFORMS`` pointing at the real TPU, so setting the env var here
+is too late for the platform choice — use ``jax.config.update`` instead.
+``XLA_FLAGS`` is still honored because the CPU backend only parses it at
+first backend initialisation, which happens inside the tests.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
