@@ -133,7 +133,9 @@ class FusedScaleMaskSoftmax:
         scale = self.scale if self.scale is not None else 1.0
         if self.fusion:
             if self.attn_mask_type == AttnMaskType.causal:
-                return scaled_upper_triang_masked_softmax(x, scale)
+                # the reference kernel asserts mask is None here; the fused
+                # path supports both masks at once, matching the unfused path
+                return _fused_softmax(x, mask, float(scale), True)
             return scaled_masked_softmax(x, mask, scale)
         # unfused parity path (reference forward_torch_softmax :173-186)
         xs = x.astype(jnp.float32) if self.softmax_in_fp32 else x

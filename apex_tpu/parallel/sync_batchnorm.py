@@ -165,13 +165,17 @@ class SyncBatchNorm:
 
     def apply(self, variables, x, *, training: bool = True):
         params, state = variables["params"], variables["state"]
+        # batch stats (and hence the group collective) are used when training
+        # OR when not tracking running stats — reference
+        # optimized_sync_batchnorm.py:85 `self.training or not self.track_running_stats`
+        use_batch_stats = training or not self.track_running_stats
         y, rm, rv = sync_batch_norm(
             x,
             params.get("weight"),
             params.get("bias"),
             state.get("running_mean"),
             state.get("running_var"),
-            axis_name=self.process_group if training else None,
+            axis_name=self.process_group if use_batch_stats else None,
             training=training,
             momentum=self.momentum,
             eps=self.eps,

@@ -1,0 +1,16 @@
+"""apex_tpu.transformer — Megatron-style model parallelism over the mesh.
+
+TPU-native re-design of ``apex.transformer`` (SURVEY.md §2.7): the
+TP × PP × DP decomposition is one ``jax.sharding.Mesh`` with axes
+("data", "pipeline", "tensor"); tensor-parallel layers are plain-collective
+functions whose backwards are derived by JAX AD; pipeline schedules are
+compiled ``ppermute`` loops.
+"""
+
+from apex_tpu.transformer import parallel_state  # noqa: F401
+from apex_tpu.transformer import tensor_parallel  # noqa: F401
+from apex_tpu.transformer.enums import (  # noqa: F401
+    AttnMaskType,
+    AttnType,
+    LayerType,
+)

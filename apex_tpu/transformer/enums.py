@@ -1,0 +1,19 @@
+"""Transformer enums — mirror of apex/transformer/enums.py.
+
+``AttnMaskType`` is defined once in :mod:`apex_tpu.ops.fused_softmax` (the
+consumer) and re-exported here so the two import paths compare equal.
+"""
+
+import enum
+
+from apex_tpu.ops.fused_softmax import AttnMaskType  # noqa: F401
+
+
+class LayerType(enum.Enum):
+    encoder = 1
+    decoder = 2
+
+
+class AttnType(enum.Enum):
+    self_attn = 1
+    cross_attn = 2

@@ -1,0 +1,227 @@
+"""Model-parallel state: the device mesh and its named axes.
+
+TPU-native re-design of ``apex.transformer.parallel_state``
+(reference apex/transformer/parallel_state.py:58-396).
+
+The reference builds explicit ``torch.distributed`` process groups for the
+TP × PP × DP 3-D decomposition (initialize_model_parallel :58-167) plus an
+embedding group (first+last pipeline stage :143-167), and every layer asks
+it for group handles and ranks.  On TPU there are no process groups: one
+``jax.sharding.Mesh`` with axes ``("data", "pipeline", "tensor")`` carries
+the whole decomposition, collectives take an axis *name*, and the "group"
+for any collective is implied by the axes not mentioned.  The tensor axis is
+innermost so TP collectives ride the fastest ICI links.
+
+This module keeps the reference's global-registry ergonomics: call
+:func:`initialize_model_parallel` once, then layers/schedules query axis
+names and sizes from anywhere (including inside ``shard_map``-traced code,
+where *rank* getters return traced ``axis_index`` values).
+
+Virtual pipeline (interleaved 1F1B) carries over as a chunk count per stage
+(reference virtual rank bookkeeping :100-107) — scheduling state, not mesh
+state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis names (the reference's group names).
+DATA_AXIS = "data"
+PIPELINE_AXIS = "pipeline"
+TENSOR_AXIS = "tensor"
+
+
+@dataclasses.dataclass
+class _ParallelState:
+    mesh: Mesh
+    tensor_model_parallel_size: int
+    pipeline_model_parallel_size: int
+    data_parallel_size: int
+    virtual_pipeline_model_parallel_size: Optional[int] = None
+    # mutable scheduling cursor used by the interleaved schedule, mirroring
+    # get/set_virtual_pipeline_model_parallel_rank (reference :100-107)
+    virtual_pipeline_model_parallel_rank: int = 0
+
+
+_STATE: Optional[_ParallelState] = None
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size_: int = 1,
+    pipeline_model_parallel_size_: int = 1,
+    virtual_pipeline_model_parallel_size_: Optional[int] = None,
+    *,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build and register the global mesh (reference parallel_state.py:58).
+
+    world = dp × pp × tp, with dp inferred from the device count exactly as
+    the reference infers it from world size (:86-99).
+    """
+    global _STATE
+    devs = list(devices if devices is not None else jax.devices())
+    world = len(devs)
+    tp, pp = tensor_model_parallel_size_, pipeline_model_parallel_size_
+    if world % (tp * pp) != 0:
+        raise RuntimeError(
+            f"world size ({world}) is not divisible by tensor ({tp}) x "
+            f"pipeline ({pp}) parallel sizes")
+    dp = world // (tp * pp)
+    if virtual_pipeline_model_parallel_size_ is not None and pp <= 2:
+        raise RuntimeError(
+            "pipeline-model-parallel size should be greater than 2 with "
+            "interleaved schedule")
+    mesh = Mesh(
+        np.asarray(devs).reshape(dp, pp, tp),
+        (DATA_AXIS, PIPELINE_AXIS, TENSOR_AXIS),
+    )
+    _STATE = _ParallelState(
+        mesh=mesh,
+        tensor_model_parallel_size=tp,
+        pipeline_model_parallel_size=pp,
+        data_parallel_size=dp,
+        virtual_pipeline_model_parallel_size=virtual_pipeline_model_parallel_size_,
+    )
+    return mesh
+
+
+def model_parallel_is_initialized() -> bool:
+    """Reference parallel_state.py:181-186."""
+    return _STATE is not None
+
+
+def _state() -> _ParallelState:
+    if _STATE is None:
+        raise RuntimeError("model parallel state is not initialized — call "
+                           "initialize_model_parallel() first")
+    return _STATE
+
+
+def get_mesh() -> Mesh:
+    return _state().mesh
+
+
+# --- world sizes (static) ---------------------------------------------------
+
+def get_tensor_model_parallel_world_size() -> int:
+    return _state().tensor_model_parallel_size
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return _state().pipeline_model_parallel_size
+
+
+def get_data_parallel_world_size() -> int:
+    return _state().data_parallel_size
+
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return _state().virtual_pipeline_model_parallel_size
+
+
+# --- axis names (the "groups") ---------------------------------------------
+
+def get_tensor_model_parallel_group() -> str:
+    """The reference returns a ProcessGroup (:188); here the axis name is
+    the group — pass it to any jax collective."""
+    return TENSOR_AXIS
+
+
+def get_pipeline_model_parallel_group() -> str:
+    return PIPELINE_AXIS
+
+
+def get_data_parallel_group() -> str:
+    return DATA_AXIS
+
+
+def get_model_parallel_groups() -> Tuple[str, str]:
+    """Axes spanning the model-parallel block (TP × PP) — what the
+    reference's amp GradScaler reduces found_inf over (grad_scaler.py:25-36)."""
+    return (PIPELINE_AXIS, TENSOR_AXIS)
+
+
+def get_embedding_axis() -> str:
+    """The reference's embedding group ties word-embedding grads between the
+    first and last pipeline stage (:143-167).  In SPMD the tie is a masked
+    psum over the pipeline axis; this is that axis."""
+    return PIPELINE_AXIS
+
+
+# --- ranks (traced inside shard_map, 0 outside) -----------------------------
+
+def _axis_rank(axis: str):
+    try:
+        return jax.lax.axis_index(axis)
+    except NameError:
+        return 0
+
+
+def get_tensor_model_parallel_rank():
+    """Inside shard_map-traced code: the traced TP coordinate of this device
+    (reference :330).  Outside: 0."""
+    return _axis_rank(TENSOR_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    return _axis_rank(PIPELINE_AXIS)
+
+
+def get_data_parallel_rank():
+    return _axis_rank(DATA_AXIS)
+
+
+def is_pipeline_first_stage(ignore_virtual: bool = False):
+    """Traced predicate (reference :262-276). With virtual pipelining and
+    ``ignore_virtual=False``, additionally requires virtual rank 0."""
+    first = get_pipeline_model_parallel_rank() == 0
+    st = _state()
+    if (not ignore_virtual
+            and st.virtual_pipeline_model_parallel_size is not None):
+        first = first & (st.virtual_pipeline_model_parallel_rank == 0)
+    return first
+
+
+def is_pipeline_last_stage(ignore_virtual: bool = False):
+    st = _state()
+    last = get_pipeline_model_parallel_rank() == st.pipeline_model_parallel_size - 1
+    if (not ignore_virtual
+            and st.virtual_pipeline_model_parallel_size is not None):
+        last = last & (st.virtual_pipeline_model_parallel_rank
+                       == st.virtual_pipeline_model_parallel_size - 1)
+    return last
+
+
+def get_virtual_pipeline_model_parallel_rank() -> int:
+    return _state().virtual_pipeline_model_parallel_rank
+
+
+def set_virtual_pipeline_model_parallel_rank(rank: int) -> None:
+    _state().virtual_pipeline_model_parallel_rank = rank
+
+
+def get_tensor_model_parallel_src_rank() -> int:
+    """Reference :349-355 computes the global rank of tp-rank-0 in one's TP
+    group for broadcast_data; with a mesh the source is simply tp index 0."""
+    return 0
+
+
+def get_rank_info() -> Tuple[int, int, int]:
+    """(tp, pp, dp) rank triple for log records (reference :169-178).
+    Host-side: process-level info only (single-controller SPMD has no
+    per-device host rank), so returns zeros outside traced code."""
+    if _STATE is None:
+        return (0, 0, 0)
+    return (0, 0, jax.process_index())
+
+
+def destroy_model_parallel() -> None:
+    """Reference :373-396."""
+    global _STATE
+    _STATE = None

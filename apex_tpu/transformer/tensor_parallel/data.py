@@ -1,0 +1,40 @@
+"""Keyed tensor-dict broadcast across the TP axis.
+
+TPU-native re-design of ``apex.transformer.tensor_parallel.data``
+(reference data.py:77-113): the reference broadcasts sizes then a flattened
+payload from TP-rank-0 so every rank in a TP group trains on identical data.
+
+Under SPMD the inputs arrive already replicated across the tensor axis (the
+data pipeline shards over "data" only), so broadcast_data reduces to an
+*enforcement*: every rank adopts tp-rank-0's values via masked psum — the
+same mechanism as :func:`apex_tpu.parallel.broadcast_params`.  dtype checks
+mirror _check_data_types (reference data.py:17-27).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+
+
+def broadcast_data(keys: Sequence[str], data: Dict[str, jnp.ndarray], datatype,
+                   axis_name: str = TENSOR_AXIS) -> Dict[str, jnp.ndarray]:
+    """Return ``{key: tp-rank-0's value}`` for each key (reference data.py:77).
+
+    Must run inside a region binding ``axis_name``.
+    """
+    out = {}
+    rank = jax.lax.axis_index(axis_name)
+    for k in keys:
+        v = data[k]
+        if v.dtype != datatype:
+            raise ValueError(
+                f"{k} has data type {v.dtype} which is different than {datatype}")
+        # integer payloads ride the same masked-psum path in their own dtype
+        masked = jnp.where(rank == 0, v, jnp.zeros_like(v))
+        out[k] = jax.lax.psum(masked, axis_name)
+    return out

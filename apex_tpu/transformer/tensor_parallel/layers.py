@@ -1,0 +1,247 @@
+"""Tensor-parallel layers: Column/Row-parallel linear, vocab-parallel embedding.
+
+TPU-native re-design of ``apex.transformer.tensor_parallel.layers``
+(reference layers.py:127-477).
+
+Each layer is a functional module (init/apply) whose parameters are the
+*local shard* for the device's TP rank — matching the reference's
+per-rank ``Parameter`` shapes so checkpoints line up:
+
+* ``ColumnParallelLinear`` (:243-362): weight [out/tp, in] per rank; input is
+  copied to the TP region (backward all-reduce), output optionally gathered.
+* ``RowParallelLinear`` (:365-477): weight [out, in/tp]; input optionally
+  scattered; local GEMM then forward all-reduce; bias added *after* the
+  reduce on every rank.
+* ``VocabParallelEmbedding`` (:127-203): vocab dim sharded; out-of-shard
+  tokens masked to 0 and the gathered embeddings all-reduced.
+
+Init uses the reference's master-weight-then-shard scheme
+(``_initialize_affine_weight_cpu`` :78-124): materialise the full weight
+from one seed, slice this rank's shard — so results are independent of tp
+size, which the parity tests rely on (run_layers_test.py master-weight
+equivalence).
+
+``apply`` must run inside a region binding the "tensor" axis (shard_map
+over the mesh).  Parameter *init* is host-side: call ``init_shard`` with an
+explicit rank to build each shard (or ``init_master`` + ``shard_master``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    scatter_to_tensor_model_parallel_region,
+)
+
+
+def _master_init(key, shape, dtype, init_method):
+    if init_method is None:
+        # reference default: xavier-style normal (init.xavier_normal_)
+        fan_in, fan_out = shape[-1], shape[0]
+        std = (2.0 / (fan_in + fan_out)) ** 0.5
+        return (jax.random.normal(key, shape) * std).astype(dtype)
+    return init_method(key, shape).astype(dtype)
+
+
+class ColumnParallelLinear:
+    """Y = XA + b with A sharded along its output (column) dimension
+    (reference layers.py:243).  ``gather_output=True`` returns the full Y on
+    every rank; ``False`` leaves Y sharded for a following RowParallel layer.
+    """
+
+    def __init__(self, input_size: int, output_size: int, *, bias: bool = True,
+                 gather_output: bool = True, init_method=None,
+                 stride: int = 1, keep_master_weight_for_test: bool = False,
+                 skip_bias_add: bool = False,
+                 tp_size: Optional[int] = None, axis_name: str = TENSOR_AXIS):
+        from apex_tpu.transformer import parallel_state
+        self.input_size = input_size
+        self.output_size = output_size
+        self.use_bias = bias
+        self.gather_output = gather_output
+        self.skip_bias_add = skip_bias_add
+        self.init_method = init_method
+        self.axis_name = axis_name
+        self.tp = (tp_size if tp_size is not None
+                   else parallel_state.get_tensor_model_parallel_world_size())
+        if output_size % self.tp != 0:
+            raise ValueError("output_size must be divisible by tp size")
+        self.output_size_per_partition = output_size // self.tp
+
+    def init_master(self, key, dtype=jnp.float32):
+        w = _master_init(key, (self.output_size, self.input_size), dtype,
+                         self.init_method)
+        p = {"weight": w}
+        if self.use_bias:
+            p["bias"] = jnp.zeros((self.output_size,), dtype)
+        return p
+
+    def shard_master(self, master, rank: int):
+        o = self.output_size_per_partition
+        p = {"weight": master["weight"][rank * o:(rank + 1) * o]}
+        if self.use_bias:
+            p["bias"] = master["bias"][rank * o:(rank + 1) * o]
+        return p
+
+    def init_shard(self, key, rank: int, dtype=jnp.float32):
+        return self.shard_master(self.init_master(key, dtype), rank)
+
+    def apply(self, params, x):
+        x = copy_to_tensor_model_parallel_region(x, self.axis_name)
+        y = jax.lax.dot_general(
+            x, params["weight"], (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        bias = params.get("bias")
+        if bias is not None and not self.skip_bias_add:
+            y = y + bias.astype(jnp.float32)
+        y = y.astype(x.dtype)
+        if self.gather_output:
+            y = gather_from_tensor_model_parallel_region(y, self.axis_name)
+        if self.skip_bias_add:
+            # reference returns (output, bias) for downstream fused add
+            return y, bias
+        return y
+
+    __call__ = apply
+
+
+class RowParallelLinear:
+    """Y = XA + b with A sharded along its input (row) dimension
+    (reference layers.py:365).  ``input_is_parallel=True`` means X is already
+    sharded (the output of a ColumnParallel layer with gather_output=False).
+    """
+
+    def __init__(self, input_size: int, output_size: int, *, bias: bool = True,
+                 input_is_parallel: bool = False, init_method=None,
+                 stride: int = 1, keep_master_weight_for_test: bool = False,
+                 skip_bias_add: bool = False,
+                 tp_size: Optional[int] = None, axis_name: str = TENSOR_AXIS):
+        from apex_tpu.transformer import parallel_state
+        self.input_size = input_size
+        self.output_size = output_size
+        self.use_bias = bias
+        self.input_is_parallel = input_is_parallel
+        self.skip_bias_add = skip_bias_add
+        self.init_method = init_method
+        self.axis_name = axis_name
+        self.tp = (tp_size if tp_size is not None
+                   else parallel_state.get_tensor_model_parallel_world_size())
+        if input_size % self.tp != 0:
+            raise ValueError("input_size must be divisible by tp size")
+        self.input_size_per_partition = input_size // self.tp
+
+    def init_master(self, key, dtype=jnp.float32):
+        w = _master_init(key, (self.output_size, self.input_size), dtype,
+                         self.init_method)
+        p = {"weight": w}
+        if self.use_bias:
+            p["bias"] = jnp.zeros((self.output_size,), dtype)
+        return p
+
+    def shard_master(self, master, rank: int):
+        i = self.input_size_per_partition
+        p = {"weight": master["weight"][:, rank * i:(rank + 1) * i]}
+        if self.use_bias:
+            p["bias"] = master["bias"]  # bias is replicated (applied post-reduce)
+        return p
+
+    def init_shard(self, key, rank: int, dtype=jnp.float32):
+        return self.shard_master(self.init_master(key, dtype), rank)
+
+    def apply(self, params, x):
+        if not self.input_is_parallel:
+            x = scatter_to_tensor_model_parallel_region(x, self.axis_name)
+        y = jax.lax.dot_general(
+            x, params["weight"], (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(x.dtype)
+        y = reduce_from_tensor_model_parallel_region(y, self.axis_name)
+        bias = params.get("bias")
+        if self.skip_bias_add:
+            return y, bias
+        if bias is not None:
+            y = (y.astype(jnp.float32) + bias.astype(jnp.float32)).astype(y.dtype)
+        return y
+
+    __call__ = apply
+
+
+class VocabParallelEmbedding:
+    """Embedding table sharded along the vocab dimension
+    (reference layers.py:127-203): tokens outside this rank's range produce
+    zeros; the per-rank partial lookups are summed with one all-reduce.
+    """
+
+    def __init__(self, num_embeddings: int, embedding_dim: int, *,
+                 init_method=None, tp_size: Optional[int] = None,
+                 axis_name: str = TENSOR_AXIS):
+        from apex_tpu.transformer import parallel_state
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.init_method = init_method
+        self.axis_name = axis_name
+        self.tp = (tp_size if tp_size is not None
+                   else parallel_state.get_tensor_model_parallel_world_size())
+        if num_embeddings % self.tp != 0:
+            raise ValueError("num_embeddings must be divisible by tp size")
+        self.num_embeddings_per_partition = num_embeddings // self.tp
+
+    def init_master(self, key, dtype=jnp.float32):
+        if self.init_method is None:
+            w = jax.random.normal(
+                key, (self.num_embeddings, self.embedding_dim)).astype(dtype)
+        else:
+            w = self.init_method(
+                key, (self.num_embeddings, self.embedding_dim)).astype(dtype)
+        return {"weight": w}
+
+    def shard_master(self, master, rank: int):
+        n = self.num_embeddings_per_partition
+        return {"weight": master["weight"][rank * n:(rank + 1) * n]}
+
+    def init_shard(self, key, rank: int, dtype=jnp.float32):
+        return self.shard_master(self.init_master(key, dtype), rank)
+
+    def apply(self, params, token_ids):
+        n = self.num_embeddings_per_partition
+        rank = jax.lax.axis_index(self.axis_name)
+        start = rank * n
+        # mask + clamp local ids (reference layers.py:168-177)
+        local = token_ids - start
+        in_range = (local >= 0) & (local < n)
+        local = jnp.clip(local, 0, n - 1)
+        emb = jnp.take(params["weight"], local, axis=0)
+        emb = jnp.where(in_range[..., None], emb, 0)
+        return reduce_from_tensor_model_parallel_region(emb, self.axis_name)
+
+    __call__ = apply
+
+
+# Parameter TP metadata (reference layers.py:37-75) — in JAX sharding is
+# carried by the arrays themselves / the mesh spec, but the attribute API is
+# kept for porting convenience.
+
+def set_tensor_model_parallel_attributes(param_meta: dict, is_parallel: bool,
+                                         dim: int, stride: int = 1) -> dict:
+    param_meta.update(tensor_model_parallel=is_parallel,
+                      partition_dim=dim, partition_stride=stride)
+    return param_meta
+
+
+def param_is_not_tensor_parallel_duplicate(param_meta: dict) -> bool:
+    """Reference layers.py:44-47: a param is "not a duplicate" if it is TP
+    (every shard unique) OR we are tp-rank 0 (the canonical copy of a
+    replicated param)."""
+    from apex_tpu.transformer import parallel_state
+
+    if param_meta.get("tensor_model_parallel", False):
+        return True
+    rank = parallel_state.get_tensor_model_parallel_rank()
+    return bool(rank == 0) if isinstance(rank, int) else (rank == 0)

@@ -1,0 +1,69 @@
+"""The four tensor-parallel region primitives.
+
+TPU-native re-design of ``apex.transformer.tensor_parallel.mappings``
+(reference mappings.py:77-159).
+
+The reference implements each mapping as a ``torch.autograd.Function`` pair
+because torch's autograd cannot transpose process-group collectives — the
+backward all-reduce of ``copy_to`` (:77-91) and friends must be written by
+hand.  JAX *can* transpose collectives: inside ``shard_map``,
+``psum``/``all_gather``/``dynamic_slice`` each have the correct adjoint
+(psum ↔ cotangent-psum, all_gather ↔ reduce-scatter, slice ↔ masked
+scatter-add), so the mappings here are plain forward functions and autodiff
+derives exactly the backward table of the reference:
+
+=============================  ============  =======================
+ primitive                      forward       derived backward
+=============================  ============  =======================
+ copy_to_...    (ref :77)       identity      psum (via the producing
+                                              collective's transpose)
+ reduce_from_...(ref :93)       psum          identity
+ scatter_to_... (ref :109)      split last    all-gather
+ gather_from_...(ref :125)      all-gather    split last
+=============================  ============  =======================
+
+Writing custom VJPs for these (as a torch port would) *breaks* gradients
+under ``shard_map``, which scales cotangents at region boundaries assuming
+true adjoints — a worked example lives in tests/L0/test_tensor_parallel.py.
+
+Splits are along the last dimension in equal chunks per TP rank
+(reference utils.split_tensor_along_last_dim).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+
+
+def _split_last_dim(x, axis_name):
+    """This rank's chunk of the last dim (reference mappings.py:29-41)."""
+    world = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    chunk = x.shape[-1] // world
+    return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=x.ndim - 1)
+
+
+def copy_to_tensor_model_parallel_region(x, axis_name: str = TENSOR_AXIS):
+    """Identity forward; the conjugate all-reduce appears in the backward of
+    whatever collective produced the replicated ``x`` (reference :77-91)."""
+    del axis_name
+    return x
+
+
+def reduce_from_tensor_model_parallel_region(x, axis_name: str = TENSOR_AXIS):
+    """All-reduce forward, identity backward (reference :93-107)."""
+    return jax.lax.psum(x, axis_name)
+
+
+def scatter_to_tensor_model_parallel_region(x, axis_name: str = TENSOR_AXIS):
+    """Split the last dim, keep own chunk; backward all-gathers
+    (reference :109-123)."""
+    return _split_last_dim(x, axis_name)
+
+
+def gather_from_tensor_model_parallel_region(x, axis_name: str = TENSOR_AXIS):
+    """All-gather along the last dim; backward splits (reference :125-139)."""
+    return jax.lax.all_gather(x, axis_name, axis=x.ndim - 1, tiled=True)

@@ -1,0 +1,128 @@
+"""TP-aware RNG and activation checkpointing.
+
+TPU-native re-design of ``apex.transformer.tensor_parallel.random``
+(reference random.py).
+
+The reference maintains a ``CudaRNGStatesTracker`` (:113-190) of named CUDA
+RNG states so dropout can be *identical* across TP ranks for replicated
+activations and *different* for sharded ones, seeded by
+``model_parallel_cuda_manual_seed`` (:193-221): data-parallel seed = seed,
+tensor-parallel seed = seed + 2718 + tp_rank.  JAX RNG is functional, so
+"states" become named base keys and forking is ``jax.random.fold_in`` —
+no mutation, no state capture/restore.
+
+Activation checkpointing (``CheckpointFunction`` :224-308) — recompute in
+backward with RNG replay — is ``jax.checkpoint``: recompute is what it does,
+and RNG replay is free because keys are values.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+
+_MODEL_PARALLEL_RNG_TRACKER_NAME = "model-parallel-rng"
+
+
+class RngStatesTracker:
+    """Named RNG keys (reference CudaRNGStatesTracker random.py:113).
+
+    ``add(name, seed)`` registers a stream; ``fork(name)`` returns a fresh
+    key for this trace step (callers thread a step/counter via ``fold_in``).
+    """
+
+    def __init__(self):
+        self.states_: Dict[str, jax.Array] = {}
+
+    def reset(self):
+        self.states_ = {}
+
+    def get_states(self):
+        return dict(self.states_)
+
+    def set_states(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name: str, seed: int):
+        if name in self.states_:
+            raise Exception(f"seed {name} already exists")
+        key = jax.random.PRNGKey(seed)
+        for existing in self.states_.values():
+            if bool(jnp.all(existing == key)):
+                raise Exception(f"seed {seed} already exists")
+        self.states_[name] = key
+
+    def fork(self, name: str = _MODEL_PARALLEL_RNG_TRACKER_NAME,
+             counter: int = 0) -> jax.Array:
+        """Return the named key folded with ``counter``.  Unlike the
+        reference's context manager (which mutates global CUDA state), the
+        caller passes the returned key into its random op."""
+        if name not in self.states_:
+            raise Exception(f"seed {name} is not added")
+        return jax.random.fold_in(self.states_[name], counter)
+
+
+_RNG_STATE_TRACKER = RngStatesTracker()
+
+
+def get_cuda_rng_tracker() -> RngStatesTracker:
+    """Name kept for porting convenience (reference random.py:188)."""
+    return _RNG_STATE_TRACKER
+
+
+get_rng_tracker = get_cuda_rng_tracker
+
+
+def model_parallel_cuda_manual_seed(seed: int, tp_rank=None) -> None:
+    """Seed both streams (reference random.py:193-221):
+    default stream = ``seed`` (same across TP for data parallelism),
+    model-parallel stream = ``seed + 2718 + tp_rank`` (different per rank).
+
+    ``tp_rank`` may be a traced ``axis_index`` — fold_in handles tracers, so
+    this works inside shard_map; host-side it defaults to 0.
+    """
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.states_["default"] = jax.random.PRNGKey(seed)
+    tp_key = jax.random.PRNGKey(seed + 2718)
+    if tp_rank is None:
+        try:
+            tp_rank = jax.lax.axis_index(TENSOR_AXIS)
+        except NameError:
+            tp_rank = 0
+    _RNG_STATE_TRACKER.states_[_MODEL_PARALLEL_RNG_TRACKER_NAME] = (
+        jax.random.fold_in(tp_key, tp_rank))
+
+
+model_parallel_seed = model_parallel_cuda_manual_seed
+
+
+def checkpoint(function, *args, policy=None):
+    """Activation checkpointing (reference CheckpointFunction random.py:224 +
+    ``checkpoint`` :291): recompute ``function`` in the backward pass.
+
+    ``policy`` is a ``jax.checkpoint_policies`` entry for selective
+    rematerialisation — strictly more control than the reference's
+    all-or-nothing recompute."""
+    return jax.checkpoint(function, policy=policy)(*args)
+
+
+def split_tensor_into_1d_equal_chunks(x: jnp.ndarray,
+                                      axis_name: str = TENSOR_AXIS):
+    """Shard a flattened activation across TP ranks
+    (reference random.py:247-266 — the distributed hidden-state buffer of
+    memory-efficient checkpointing, precursor of sequence parallelism)."""
+    flat = x.reshape(-1)
+    world = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    chunk = flat.shape[0] // world
+    return jax.lax.dynamic_slice_in_dim(flat, rank * chunk, chunk)
+
+
+def gather_split_1d_tensor(x: jnp.ndarray, axis_name: str = TENSOR_AXIS):
+    """Inverse of :func:`split_tensor_into_1d_equal_chunks`
+    (reference utils.py:34-46)."""
+    return jax.lax.all_gather(x, axis_name, axis=0, tiled=True)

@@ -1,0 +1,46 @@
+"""Tensor-parallel helpers (reference apex/transformer/tensor_parallel/utils.py
+and apex/transformer/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+
+def ensure_divisibility(numerator: int, denominator: int) -> None:
+    """Reference utils.py:9-11."""
+    if numerator % denominator != 0:
+        raise ValueError(f"{numerator} is not divisible by {denominator}")
+
+
+def divide(numerator: int, denominator: int) -> int:
+    """Reference utils.py:14-17."""
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def split_tensor_along_last_dim(x: jnp.ndarray, num_partitions: int
+                                ) -> Tuple[jnp.ndarray, ...]:
+    """Reference tensor_parallel/utils.py split helper: equal chunks of the
+    last dimension."""
+    last = x.shape[-1]
+    chunk = divide(last, num_partitions)
+    return tuple(x[..., i * chunk:(i + 1) * chunk] for i in range(num_partitions))
+
+
+class VocabUtility:
+    """Reference layers.py vocab range helpers (used by the embedding and CE)."""
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(per_partition_vocab_size: int,
+                                                  rank, world_size: int):
+        first = rank * per_partition_vocab_size
+        return first, first + per_partition_vocab_size
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(global_vocab_size: int, rank,
+                                           world_size: int):
+        per = divide(global_vocab_size, world_size)
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per, rank, world_size)
