@@ -7,10 +7,16 @@ functions whose backwards are derived by JAX AD; pipeline schedules are
 compiled ``ppermute`` loops.
 """
 
+from apex_tpu.transformer import amp  # noqa: F401
 from apex_tpu.transformer import parallel_state  # noqa: F401
+from apex_tpu.transformer import pipeline_parallel  # noqa: F401
 from apex_tpu.transformer import tensor_parallel  # noqa: F401
 from apex_tpu.transformer.enums import (  # noqa: F401
     AttnMaskType,
     AttnType,
     LayerType,
+)
+from apex_tpu.transformer.log_util import (  # noqa: F401
+    get_transformer_logger,
+    set_logging_level,
 )

@@ -1,0 +1,7 @@
+"""apex_tpu.transformer._data — DP-sharded batch samplers
+(reference apex/transformer/_data/)."""
+
+from apex_tpu.transformer._data._batchsampler import (  # noqa: F401
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+)

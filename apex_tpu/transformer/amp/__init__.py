@@ -1,0 +1,4 @@
+"""apex_tpu.transformer.amp — model-parallel-aware grad scaling
+(reference apex/transformer/amp/grad_scaler.py)."""
+
+from apex_tpu.transformer.amp.grad_scaler import GradScaler  # noqa: F401

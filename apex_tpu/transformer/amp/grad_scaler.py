@@ -1,0 +1,52 @@
+"""Model-parallel-aware gradient scaler.
+
+TPU-native re-design of ``apex.transformer.amp.GradScaler``
+(reference amp/grad_scaler.py:8-106): a ``torch.cuda.amp.GradScaler``
+subclass whose only change is all-reducing ``found_inf`` across the
+model-parallel group in ``step`` (:25-36) and ``update`` (:88-98), so a TP/PP
+shard that overflows makes *every* rank skip the step.
+
+Here the scaler composes :class:`apex_tpu.amp.LossScaler` (the pure
+loss-scale state machine) with a finite-check that psums across the
+model-parallel axes — one fused collective instead of a D2H poll.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler, LossScaleState
+from apex_tpu.transformer.parallel_state import PIPELINE_AXIS, TENSOR_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class GradScaler(LossScaler):
+    """LossScaler whose overflow verdict is agreed across the model-parallel
+    block (reference grad_scaler.py:25-36, :88-98)."""
+
+    model_parallel_axes: Sequence[str] = (PIPELINE_AXIS, TENSOR_AXIS)
+
+    def found_inf(self, grads) -> jnp.ndarray:
+        """True if any grad anywhere in the MP block is non-finite.  Must run
+        inside a region binding the model-parallel axes; falls back to the
+        local check outside one."""
+        local = jnp.logical_not(all_finite(grads))
+        try:
+            # max over the MP block: any rank's overflow poisons all
+            return jax.lax.pmax(local.astype(jnp.int32),
+                                self.model_parallel_axes).astype(bool)
+        except NameError:
+            return local
+
+
+def all_finite(tree) -> jnp.ndarray:
+    """Single fused all-finite reduction over a pytree."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.array(True)
+    finite = [jnp.all(jnp.isfinite(l)) for l in leaves]
+    return jnp.stack(finite).all()

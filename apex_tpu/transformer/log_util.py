@@ -1,0 +1,23 @@
+"""Transformer logger with env-var level
+(reference apex/transformer/log_util.py:1-19)."""
+
+from __future__ import annotations
+
+import logging
+import os
+
+
+def get_transformer_logger(name: str) -> logging.Logger:
+    name_wo_ext = os.path.splitext(name)[0]
+    return logging.getLogger(name_wo_ext)
+
+
+def set_logging_level(verbosity) -> None:
+    """Reference: APEX_TRANSFORMER_LOG_LEVEL env var override."""
+    logging.getLogger("apex_tpu.transformer").setLevel(verbosity)
+
+
+_level = os.environ.get("APEX_TPU_TRANSFORMER_LOG_LEVEL",
+                        os.environ.get("APEX_TRANSFORMER_LOG_LEVEL"))
+if _level is not None:
+    set_logging_level(int(_level) if _level.isdigit() else _level)

@@ -1,0 +1,31 @@
+"""apex_tpu.transformer.pipeline_parallel — compiled pipeline schedules
+over the mesh "pipeline" axis (reference apex/transformer/pipeline_parallel/).
+"""
+
+from apex_tpu.transformer.pipeline_parallel.microbatches import (  # noqa: F401
+    ConstantNumMicroBatches,
+    NumMicroBatchesCalculator,
+    RampupBatchsizeNumMicroBatches,
+    build_num_microbatches_calculator,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+)
+from apex_tpu.transformer.pipeline_parallel.utils import (  # noqa: F401
+    average_losses_across_data_parallel_group,
+    calc_params_l2_norm,
+    destroy_microbatch_calculator,
+    get_current_global_batch_size,
+    get_kth_microbatch,
+    get_ltor_masks_and_position_ids,
+    get_num_microbatches,
+    listify_model,
+    report_memory,
+    setup_microbatch_calculator,
+    split_into_microbatches,
+    unwrap_model,
+    update_num_microbatches,
+)

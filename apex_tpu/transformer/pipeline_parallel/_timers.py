@@ -1,0 +1,87 @@
+"""Named timers.
+
+TPU-native port of ``apex.transformer.pipeline_parallel._timers``
+(reference _timers.py:1-83).  The reference cuda-synchronizes around
+start/stop; here the device-sync is ``block_until_ready`` on a token the
+caller passes (or nothing for host-side phases).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+
+class _Timer:
+    """Reference _timers.py:9-39."""
+
+    def __init__(self, name: str):
+        self.name_ = name
+        self.elapsed_ = 0.0
+        self.started_ = False
+        self.start_time = time.time()
+
+    def start(self, sync_on=None):
+        if self.started_:
+            raise RuntimeError("timer has already been started")
+        if sync_on is not None:
+            import jax
+            jax.block_until_ready(sync_on)
+        self.start_time = time.time()
+        self.started_ = True
+
+    def stop(self, sync_on=None):
+        if not self.started_:
+            raise RuntimeError("timer is not started")
+        if sync_on is not None:
+            import jax
+            jax.block_until_ready(sync_on)
+        self.elapsed_ += time.time() - self.start_time
+        self.started_ = False
+
+    def reset(self):
+        self.elapsed_ = 0.0
+        self.started_ = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        started = self.started_
+        if started:
+            self.stop()
+        e = self.elapsed_
+        if reset:
+            self.reset()
+        if started:
+            self.start()
+        return e
+
+
+class Timers:
+    """Group of named timers (reference _timers.py:42-83)."""
+
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def write(self, names, writer, iteration: int, normalizer: float = 1.0,
+              reset: bool = False):
+        for name in names:
+            value = self.timers[name].elapsed(reset=reset) / normalizer
+            writer.add_scalar(name + "-time", value, iteration)
+
+    def log(self, names=None, normalizer: float = 1.0, reset: bool = True) -> str:
+        if normalizer <= 0.0:
+            raise ValueError("normalizer must be positive")
+        names = names if names is not None else list(self.timers)
+        string = "time (ms)"
+        for name in names:
+            t = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+            string += f" | {name}: {t:.2f}"
+        print(string, flush=True)
+        return string
+
+
+_Timers = Timers

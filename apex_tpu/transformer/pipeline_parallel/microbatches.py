@@ -1,0 +1,124 @@
+"""Microbatch calculators.
+
+TPU-native port of ``apex.transformer.pipeline_parallel.microbatches``
+(reference microbatches.py:21-172) — pure scheduling arithmetic, unchanged
+semantics: global batch = micro_batch_size × num_micro_batches × dp_size,
+with optional linear ramp-up of the global batch size over consumed samples.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+def build_num_microbatches_calculator(
+    rank: int,
+    rampup_batch_size: Optional[List[int]],
+    global_batch_size: int,
+    micro_batch_size: int,
+    data_parallel_size: int,
+):
+    """Reference microbatches.py:21-56."""
+    if rampup_batch_size is None:
+        return ConstantNumMicroBatches(
+            global_batch_size, micro_batch_size, data_parallel_size)
+    if len(rampup_batch_size) != 3:
+        raise ValueError(
+            "expected the following format: --rampup-batch-size <start batch "
+            "size> <batch size increment> <ramp-up samples>")
+    start, increment, samples = (int(v) for v in rampup_batch_size)
+    if rank == 0:
+        print(f"will use batch size rampup starting from global batch size "
+              f"{start} to global batch size {global_batch_size} with batch "
+              f"size increments {increment} over {samples} samples.", flush=True)
+    return RampupBatchsizeNumMicroBatches(
+        start, increment, samples, global_batch_size, micro_batch_size,
+        data_parallel_size)
+
+
+class NumMicroBatchesCalculator:
+    """Reference microbatches.py:59-76."""
+
+    def __init__(self):
+        self.num_micro_batches = None
+        self.current_global_batch_size = None
+
+    def get(self) -> int:
+        return self.num_micro_batches
+
+    def get_current_global_batch_size(self) -> int:
+        return self.current_global_batch_size
+
+    def update(self, consumed_samples, consistency_check) -> None:
+        pass
+
+
+class ConstantNumMicroBatches(NumMicroBatchesCalculator):
+    """Reference microbatches.py:79-98."""
+
+    def __init__(self, global_batch_size: int, micro_batch_size: int,
+                 data_parallel_size: int):
+        super().__init__()
+        micro_batch_times_data_parallel = micro_batch_size * data_parallel_size
+        if global_batch_size % micro_batch_times_data_parallel != 0:
+            raise ValueError(
+                f"global batch size ({global_batch_size}) is not divisible by "
+                f"micro batch size ({micro_batch_size}) times data parallel "
+                f"size ({data_parallel_size})")
+        self.num_micro_batches = global_batch_size // micro_batch_times_data_parallel
+        if self.num_micro_batches < 1:
+            raise ValueError("number of micro-batches should be at least 1")
+        self.current_global_batch_size = global_batch_size
+        self.micro_batch_size = micro_batch_size
+
+
+class RampupBatchsizeNumMicroBatches(NumMicroBatchesCalculator):
+    """Linear global-batch ramp over consumed samples
+    (reference microbatches.py:101-172)."""
+
+    def __init__(self, start_batch_size: int, batch_size_increment: int,
+                 ramup_samples: int, global_batch_size: int,
+                 micro_batch_size: int, data_parallel_size: int):
+        super().__init__()
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel_size = data_parallel_size
+        self.micro_batch_times_data_parallel_size = (
+            micro_batch_size * data_parallel_size)
+        if batch_size_increment <= 0:
+            raise ValueError("batch size increment must be positive")
+        self.start_batch_size = start_batch_size
+        self.batch_size_increment = batch_size_increment
+        self.ramup_samples = ramup_samples
+        self.global_batch_size = global_batch_size
+        diff = global_batch_size - start_batch_size
+        if diff < 0:
+            raise ValueError("global batch size must be >= start batch size")
+        if diff % batch_size_increment != 0:
+            raise ValueError(
+                "expected global batch size interval to be divisible by the "
+                "batch size increment")
+        num_increments = diff // batch_size_increment
+        self.rampup_samples_per_increment = (
+            self.ramup_samples / num_increments if num_increments > 0 else 0)
+        self.update(0, False)
+
+    def update(self, consumed_samples: int, consistency_check: bool) -> None:
+        if consumed_samples > self.ramup_samples:
+            self.current_global_batch_size = self.global_batch_size
+        else:
+            steps = int(consumed_samples / self.rampup_samples_per_increment)
+            self.current_global_batch_size = (
+                self.start_batch_size + steps * self.batch_size_increment)
+            self.current_global_batch_size = min(
+                self.current_global_batch_size, self.global_batch_size)
+        if consistency_check:
+            if (self.current_global_batch_size
+                    % self.micro_batch_times_data_parallel_size != 0):
+                raise ValueError(
+                    f"current global batch size "
+                    f"({self.current_global_batch_size}) is not divisible by "
+                    f"micro-batch-size ({self.micro_batch_size}) times data "
+                    f"parallel size ({self.data_parallel_size})")
+        self.num_micro_batches = (
+            self.current_global_batch_size
+            // self.micro_batch_times_data_parallel_size)

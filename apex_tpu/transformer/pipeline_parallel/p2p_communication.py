@@ -1,0 +1,95 @@
+"""Pipeline-stage point-to-point transfer.
+
+TPU-native re-design of
+``apex.transformer.pipeline_parallel.p2p_communication``
+(reference p2p_communication.py:31-404).
+
+The reference wraps batched NCCL ``isend/irecv`` (``_run_p2pops`` :31-69)
+in eight directional helpers, with a scatter-gather transport optimisation
+(send 1/TP of the tensor, allgather after receive, :116-178).  On TPU a
+stage transfer is one ``lax.ppermute`` over the mesh "pipeline" axis — a
+static, compiler-scheduled ICI neighbor exchange; the scatter-gather trick
+is unnecessary because GSPMD keeps sharded tensors sharded across the hop.
+
+The eight reference wrappers are kept (same names, :183-404) so schedule
+code reads identically; each is a thin view over :func:`send_recv_next` /
+:func:`send_recv_prev`.  "Receiving nothing" yields zeros — callers mask by
+stage, matching the schedules' fill/drain accounting.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
+
+
+def _perm(n: int, shift: int):
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+def send_recv_next(x: jnp.ndarray, axis_name: str = PIPELINE_AXIS) -> jnp.ndarray:
+    """Every stage sends ``x`` to stage+1 (ring); stage s receives stage
+    s-1's tensor.  The wrap-around edge (last→first) carries fill garbage
+    that schedules mask out."""
+    n = jax.lax.psum(1, axis_name)
+    return jax.lax.ppermute(x, axis_name, _perm(n, 1))
+
+
+def send_recv_prev(x: jnp.ndarray, axis_name: str = PIPELINE_AXIS) -> jnp.ndarray:
+    """Every stage sends ``x`` to stage-1 (ring); used by the backward pass."""
+    n = jax.lax.psum(1, axis_name)
+    return jax.lax.ppermute(x, axis_name, _perm(n, -1))
+
+
+# --- reference-named wrappers (p2p_communication.py:183-404) ----------------
+
+
+def recv_forward(input_tensor: jnp.ndarray,
+                 axis_name: str = PIPELINE_AXIS) -> jnp.ndarray:
+    """Receive the activation from the previous stage.  In the compiled
+    schedule the 'receive' is the permuted value of what the previous stage
+    just produced — so this takes the stage *output* grid and rotates it."""
+    return send_recv_next(input_tensor, axis_name)
+
+
+def send_forward(output_tensor: jnp.ndarray,
+                 axis_name: str = PIPELINE_AXIS) -> jnp.ndarray:
+    return send_recv_next(output_tensor, axis_name)
+
+
+def recv_backward(output_tensor_grad: jnp.ndarray,
+                  axis_name: str = PIPELINE_AXIS) -> jnp.ndarray:
+    return send_recv_prev(output_tensor_grad, axis_name)
+
+
+def send_backward(input_tensor_grad: jnp.ndarray,
+                  axis_name: str = PIPELINE_AXIS) -> jnp.ndarray:
+    return send_recv_prev(input_tensor_grad, axis_name)
+
+
+def send_forward_recv_backward(output_tensor: jnp.ndarray,
+                               output_tensor_grad: jnp.ndarray,
+                               axis_name: str = PIPELINE_AXIS):
+    return send_recv_next(output_tensor, axis_name), send_recv_prev(
+        output_tensor_grad, axis_name)
+
+
+def send_backward_recv_forward(input_tensor_grad: jnp.ndarray,
+                               input_tensor: jnp.ndarray,
+                               axis_name: str = PIPELINE_AXIS):
+    return send_recv_prev(input_tensor_grad, axis_name), send_recv_next(
+        input_tensor, axis_name)
+
+
+def send_forward_recv_forward(output_tensor: jnp.ndarray,
+                              axis_name: str = PIPELINE_AXIS) -> jnp.ndarray:
+    return send_recv_next(output_tensor, axis_name)
+
+
+def send_backward_recv_backward(input_tensor_grad: jnp.ndarray,
+                                axis_name: str = PIPELINE_AXIS) -> jnp.ndarray:
+    return send_recv_prev(input_tensor_grad, axis_name)

@@ -1,0 +1,293 @@
+"""Pipeline-parallel schedules.
+
+TPU-native re-design of
+``apex.transformer.pipeline_parallel.schedules`` (reference
+schedules/__init__.py:16-34 and the three schedule modules).
+
+The reference schedules are eager Python loops issuing blocking NCCL
+send/recv per microbatch (1F1B warmup/steady/cooldown,
+fwd_bwd_pipelining_without_interleaving.py:22-170).  Under XLA the whole
+schedule is *one compiled program*: a ``lax.scan`` over time steps in which
+every stage applies its layer block and hands its activation to the next
+stage via ``ppermute`` over the mesh "pipeline" axis.  Differentiating the
+scanned forward yields the backward pipeline automatically (the transpose
+of ``ppermute`` is the reverse ``ppermute``), so 1F1B's hand-managed
+backward scheduling collapses into ``jax.value_and_grad`` — microbatch
+grad accumulation, stage transfer, and cooldown come from the scan's
+transpose, with XLA's latency-hiding scheduler overlapping compute and ICI
+transfers.
+
+Scheduling cost model (same accounting as the reference): with ``p`` stages
+and ``m`` microbatches the compiled loop runs ``m + p - 1`` steps; the
+fill/drain bubble fraction is ``(p-1)/(m+p-1)``.  The interleaved variant
+runs virtual stages ``v = p·vpp`` in a ring, bubble ``(p-1)/(m·vpp + ...)``
+— smaller, exactly as the reference's interleaved 1F1B
+(fwd_bwd_pipelining_with_interleaving.py).
+
+SPMD note: every stage runs the same program, so stage-special work
+(embedding on the first stage, loss head on the last) is expressed with
+``jnp.where`` on ``parallel_state.get_pipeline_model_parallel_rank()``
+inside the user's ``stage_fn``.  Fill/drain steps compute on zero buffers
+and are masked out of the loss — wasted FLOPs identical to the reference's
+bubble, not extra.
+
+All schedule functions must run **inside shard_map** binding the
+"pipeline" axis (plus "tensor"/"data" if the stage uses them).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
+from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
+    send_recv_next,
+)
+
+StageFn = Callable[[Any, jnp.ndarray, Any], jnp.ndarray]
+LossFn = Callable[[jnp.ndarray, Any], jnp.ndarray]
+
+
+def _get_microbatch(microbatches, m):
+    """Dynamic-index microbatch ``m`` (clipped) out of the stacked batch."""
+    def idx(a):
+        mm = jnp.clip(m, 0, a.shape[0] - 1)
+        return jax.lax.dynamic_index_in_dim(a, mm, axis=0, keepdims=False)
+
+    return jax.tree_util.tree_map(idx, microbatches)
+
+
+def forward_backward_no_pipelining(
+    forward_step_fn: Callable[[Any, Any], jnp.ndarray],
+    params: Any,
+    microbatches: Any,
+    *,
+    n_microbatches: int,
+    forward_only: bool = False,
+    remat: bool = False,
+):
+    """Microbatched gradient accumulation, no pipelining
+    (reference fwd_bwd_no_pipelining.py:29-91: grad-accum under
+    ``model.no_sync`` then a final sync step).
+
+    ``forward_step_fn(params, microbatch) -> scalar loss``.  Returns
+    ``(mean_loss, grads)`` — grads averaged over microbatches — or
+    ``(losses,)`` stacked if ``forward_only``.
+    """
+    step = forward_step_fn
+    if remat:
+        step = jax.checkpoint(step)
+
+    if forward_only:
+        def body(_, m):
+            return None, step(params, _get_microbatch(microbatches, m))
+
+        _, losses = jax.lax.scan(body, None, jnp.arange(n_microbatches))
+        return (losses,)
+
+    grad_fn = jax.value_and_grad(step)
+
+    def body(acc, m):
+        loss_acc, grad_acc = acc
+        loss, g = grad_fn(params, _get_microbatch(microbatches, m))
+        return (loss_acc + loss,
+                jax.tree_util.tree_map(jnp.add, grad_acc, g)), None
+
+    zero_grads = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.result_type(p)), params)
+    (loss_sum, grad_sum), _ = jax.lax.scan(
+        body, (jnp.zeros(()), zero_grads), jnp.arange(n_microbatches))
+    inv = 1.0 / n_microbatches
+    return loss_sum * inv, jax.tree_util.tree_map(
+        lambda g: g * inv, grad_sum)
+
+
+def _pipelined_loss(
+    stage_fn: StageFn,
+    loss_fn: LossFn,
+    params: Any,
+    microbatches: Any,
+    *,
+    n_microbatches: int,
+    tensor_shape: Sequence[int],
+    dtype=jnp.float32,
+    axis_name: str = PIPELINE_AXIS,
+    remat: bool = False,
+):
+    """Compiled fill-steady-drain pipeline forward; returns mean loss
+    (replicated across stages via masked psum)."""
+    n_stages = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    is_last = stage == n_stages - 1
+    T = n_microbatches + n_stages - 1
+
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def body(carry, t):
+        buf, loss_sum = carry
+        m = t - stage  # microbatch index this stage handles at step t
+        mb = _get_microbatch(microbatches, m)
+        y = fn(params, buf, mb)
+        valid = (m >= 0) & (m < n_microbatches)
+        step_loss = jnp.where(valid & is_last,
+                              loss_fn(y, mb).astype(jnp.float32), 0.0)
+        # transfer to the next stage; stage 0's incoming slot carries
+        # wrap-around garbage it never reads (its stage_fn embeds from mb)
+        buf = send_recv_next(y, axis_name)
+        return (buf, loss_sum + step_loss), None
+
+    buf0 = jnp.zeros(tuple(tensor_shape), dtype)
+    (_, loss_sum), _ = jax.lax.scan(
+        body, (buf0, jnp.zeros((), jnp.float32)), jnp.arange(T))
+    # Return the *local* mean loss (nonzero only on the last stage).  The
+    # caller psums it for reporting.  Differentiating the local loss is what
+    # makes grads correct when value_and_grad runs inside shard_map: every
+    # device seeds cotangent 1.0, so a psum here would transpose into a
+    # pp-fold overcount; with the local loss, only the last stage's
+    # cotangent is live and the ppermute transposes route it backward
+    # through the stages — the compiled backward pipeline.
+    return loss_sum / n_microbatches
+
+
+def forward_backward_pipelining_without_interleaving(
+    stage_fn: StageFn,
+    loss_fn: LossFn,
+    params: Any,
+    microbatches: Any,
+    *,
+    n_microbatches: int,
+    tensor_shape: Sequence[int],
+    dtype=jnp.float32,
+    axis_name: str = PIPELINE_AXIS,
+    forward_only: bool = False,
+    remat: bool = True,
+):
+    """Non-interleaved pipelining (reference
+    fwd_bwd_pipelining_without_interleaving.py:22-170).
+
+    ``stage_fn(params, hidden_in, microbatch) -> hidden_out`` — the user's
+    per-stage block; it must select embedding/identity input by stage (see
+    module docstring).  ``loss_fn(hidden_out, microbatch) -> scalar`` —
+    evaluated on the last stage only.  ``tensor_shape`` is the inter-stage
+    activation shape, exactly the reference's ``tensor_shape`` argument
+    (seq, microbatch, hidden) passed to its p2p layer.
+
+    Returns ``(mean_loss, grads)``; ``forward_only=True`` returns
+    ``(mean_loss,)`` (reference's losses_reduced).
+    """
+    run = functools.partial(
+        _pipelined_loss, stage_fn, loss_fn,
+        n_microbatches=n_microbatches, tensor_shape=tensor_shape,
+        dtype=dtype, axis_name=axis_name, remat=remat)
+    if forward_only:
+        return (jax.lax.psum(run(params, microbatches), axis_name),)
+    loss, grads = jax.value_and_grad(run)(params, microbatches)
+    return jax.lax.psum(loss, axis_name), grads
+
+
+def _interleaved_loss(
+    chunk_fn: Callable[[Any, jnp.ndarray, Any, int], jnp.ndarray],
+    loss_fn: LossFn,
+    chunked_params: Any,
+    microbatches: Any,
+    *,
+    n_microbatches: int,
+    num_model_chunks: int,
+    tensor_shape: Sequence[int],
+    dtype=jnp.float32,
+    axis_name: str = PIPELINE_AXIS,
+    remat: bool = False,
+):
+    """Ring pipeline over p·vpp virtual stages (interleaved schedule).
+
+    Device ``d`` owns virtual stages ``d + p·k`` for local chunk
+    ``k < vpp`` (the reference's model-chunk assignment,
+    fwd_bwd_pipelining_with_interleaving.py).  Activations travel the ring
+    0→1→…→p-1→0→…; crossing the wrap edge advances the chunk index.
+    """
+    n_stages = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    vpp = num_model_chunks
+    total_virtual = n_stages * vpp
+    is_last = stage == n_stages - 1
+    T = n_microbatches + total_virtual - 1
+
+    fn = jax.checkpoint(chunk_fn, static_argnums=(3,)) if remat else chunk_fn
+
+    def body(carry, t):
+        bufs, loss_sum = carry  # bufs: [vpp, *tensor_shape]
+        ys = []
+        for k in range(vpp):
+            m = t - (stage + n_stages * k)  # µbatch at virtual stage d+p·k
+            mb = _get_microbatch(microbatches, m)
+            pk = jax.tree_util.tree_map(lambda a: a[k], chunked_params)
+            y = fn(pk, bufs[k], mb, k)
+            # last *virtual* stage: local chunk vpp-1 on last device
+            valid = (m >= 0) & (m < n_microbatches)
+            if k == vpp - 1:
+                loss_sum = loss_sum + jnp.where(
+                    valid & is_last, loss_fn(y, mb).astype(jnp.float32), 0.0)
+            ys.append(y)
+        y_stack = jnp.stack(ys)
+        r = send_recv_next(y_stack, axis_name)  # ring by device
+        # crossing p-1 → 0 advances the chunk: device 0's chunk k input is
+        # the wrapped output of chunk k-1; other devices keep chunk index
+        r_shifted = jnp.concatenate([jnp.zeros_like(r[:1]), r[:-1]], axis=0)
+        bufs = jnp.where(stage == 0, r_shifted, r)
+        return (bufs, loss_sum), None
+
+    bufs0 = jnp.zeros((vpp, *tensor_shape), dtype)
+    (_, loss_sum), _ = jax.lax.scan(
+        body, (bufs0, jnp.zeros((), jnp.float32)), jnp.arange(T))
+    # local mean loss — see the matching note in _pipelined_loss
+    return loss_sum / n_microbatches
+
+
+def forward_backward_pipelining_with_interleaving(
+    chunk_fn: Callable[[Any, jnp.ndarray, Any, int], jnp.ndarray],
+    loss_fn: LossFn,
+    chunked_params: Any,
+    microbatches: Any,
+    *,
+    n_microbatches: int,
+    num_model_chunks: int,
+    tensor_shape: Sequence[int],
+    dtype=jnp.float32,
+    axis_name: str = PIPELINE_AXIS,
+    forward_only: bool = False,
+    remat: bool = True,
+):
+    """Interleaved (virtual-pipeline) schedule — reference
+    fwd_bwd_pipelining_with_interleaving.py:1-308.
+
+    ``chunk_fn(chunk_params, hidden_in, microbatch, local_chunk_idx) ->
+    hidden_out``; ``chunked_params`` has a leading ``[vpp]`` axis per leaf
+    (this device's model chunks).  The first virtual stage embeds, the last
+    computes the head — chunk_fn selects by
+    ``(get_pipeline_model_parallel_rank(), local_chunk_idx)``.
+    """
+    run = functools.partial(
+        _interleaved_loss, chunk_fn, loss_fn,
+        n_microbatches=n_microbatches, num_model_chunks=num_model_chunks,
+        tensor_shape=tensor_shape, dtype=dtype, axis_name=axis_name,
+        remat=remat)
+    if forward_only:
+        return (jax.lax.psum(run(chunked_params, microbatches), axis_name),)
+    loss, grads = jax.value_and_grad(run)(chunked_params, microbatches)
+    return jax.lax.psum(loss, axis_name), grads
+
+
+def get_forward_backward_func(
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    pipeline_model_parallel_size: int = 1,
+):
+    """Schedule selector (reference schedules/__init__.py:16-34)."""
+    if pipeline_model_parallel_size > 1:
+        if virtual_pipeline_model_parallel_size is not None:
+            return forward_backward_pipelining_with_interleaving
+        return forward_backward_pipelining_without_interleaving
+    return forward_backward_no_pipelining

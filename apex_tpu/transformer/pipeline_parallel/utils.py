@@ -1,0 +1,171 @@
+"""Pipeline-parallel utilities.
+
+TPU-native port of ``apex.transformer.pipeline_parallel.utils``
+(reference pipeline_parallel/utils.py) — microbatch-calculator globals,
+loss averaging, Megatron mask/position-id helpers, param-norm reporting.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import DATA_AXIS
+from apex_tpu.transformer.pipeline_parallel.microbatches import (
+    build_num_microbatches_calculator,
+)
+
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+
+
+def setup_microbatch_calculator(
+    rank: int,
+    rampup_batch_size: Optional[List[int]],
+    global_batch_size: int,
+    micro_batch_size: int,
+    data_parallel_size: int,
+) -> None:
+    """Reference utils.py:57-75 (asserts single init)."""
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    if _GLOBAL_NUM_MICROBATCHES_CALCULATOR is not None:
+        raise RuntimeError("num microbatches calculator is already initialized")
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size)
+
+
+def destroy_microbatch_calculator() -> None:
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+
+
+def update_num_microbatches(consumed_samples: int,
+                            consistency_check: bool = True) -> None:
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR.update(consumed_samples,
+                                               consistency_check)
+
+
+def get_num_microbatches() -> int:
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get()
+
+
+def get_current_global_batch_size() -> int:
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get_current_global_batch_size()
+
+
+def get_micro_batch_size() -> int:
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.micro_batch_size
+
+
+def listify_model(model: Any) -> List[Any]:
+    """Reference utils.py:104-107."""
+    return model if isinstance(model, list) else [model]
+
+
+def unwrap_model(model, module_instances=()):
+    """Reference utils.py:110-128 unwraps DDP/FP16 wrappers; functional
+    pytrees have no wrappers, so this is identity-or-unlist."""
+    return_list = True
+    if not isinstance(model, list):
+        model = [model]
+        return_list = False
+    unwrapped = [getattr(m, "module", m) for m in model]
+    return unwrapped if return_list else unwrapped[0]
+
+
+def get_kth_microbatch(batch: Any, k: int, micro_batch_size: int) -> Any:
+    """Reference utils.py:137-147: slice microbatch k out of a global batch
+    along the leading dim."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.lax.dynamic_slice_in_dim(
+            a, k * micro_batch_size, micro_batch_size, axis=0), batch)
+
+
+def split_into_microbatches(batch: Any, n_microbatches: int) -> Any:
+    """Reshape [B, ...] -> [n_micro, B/n_micro, ...] for the compiled
+    schedules' stacked-microbatch input."""
+    def split(a):
+        return a.reshape(n_microbatches, a.shape[0] // n_microbatches,
+                         *a.shape[1:])
+
+    return jax.tree_util.tree_map(split, batch)
+
+
+def average_losses_across_data_parallel_group(losses: Sequence[jnp.ndarray],
+                                              axis_name: str = DATA_AXIS):
+    """Reference utils.py:218-226: stack losses and pmean over the data
+    axis.  Must run inside a region binding ``axis_name``."""
+    return jax.lax.pmean(jnp.stack([jnp.asarray(l) for l in losses]),
+                         axis_name)
+
+
+def calc_params_l2_norm(params: Any) -> jnp.ndarray:
+    """Reference utils.py:189-215 (without the TP-duplicate filtering —
+    pass only this rank's unique shards)."""
+    leaves = jax.tree_util.tree_leaves(params)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                        for l in leaves))
+
+
+def get_ltor_masks_and_position_ids(
+    data: jnp.ndarray,
+    eod_token: int,
+    reset_position_ids: bool = False,
+    reset_attention_mask: bool = False,
+    eod_mask_loss: bool = False,
+):
+    """Left-to-right (causal) masks and position ids
+    (reference utils.py:279-333).
+
+    Returns ``(attention_mask, loss_mask, position_ids)`` with the
+    reference's conventions: attention_mask boolean with True = *masked
+    out* (ready for :func:`apex_tpu.ops.scaled_masked_softmax`), loss_mask
+    1.0 where the token contributes to the loss.
+
+    The per-document reset options use a scan over the sequence instead of
+    the reference's per-eod Python loop (jit-compatible, no host sync).
+    """
+    b, seq = data.shape
+    causal = ~jnp.tril(jnp.ones((seq, seq), bool))  # True above diagonal
+    attention_mask = jnp.broadcast_to(causal, (b, 1, seq, seq))
+
+    loss_mask = jnp.ones((b, seq), jnp.float32)
+    if eod_mask_loss:
+        loss_mask = jnp.where(data == eod_token, 0.0, loss_mask)
+
+    position_ids = jnp.broadcast_to(jnp.arange(seq), (b, seq))
+
+    if reset_position_ids or reset_attention_mask:
+        is_eod = data == eod_token
+        # document id of each position = number of eods strictly before it
+        doc_id = jnp.cumsum(is_eod, axis=1) - jnp.where(is_eod, 1, 0)
+        doc_id = jnp.cumsum(jnp.pad(is_eod[:, :-1], ((0, 0), (1, 0))), axis=1)
+        if reset_attention_mask:
+            same_doc = doc_id[:, None, :, None] == doc_id[:, None, None, :]
+            attention_mask = attention_mask | ~same_doc
+        if reset_position_ids:
+            # position within document: index - index of document start
+            idx = jnp.arange(seq)[None, :]
+            doc_start = jnp.where(
+                jnp.pad(is_eod[:, :-1], ((0, 0), (1, 0))), idx, 0)
+            doc_start = jax.lax.cummax(doc_start, axis=1)
+            position_ids = idx - doc_start
+
+    return attention_mask, loss_mask, position_ids
+
+
+def report_memory(name: str) -> str:
+    """Reference utils.py:229-238 prints CUDA allocator stats; on TPU the
+    equivalent signal is per-device memory stats from the runtime."""
+    lines = [f"memory ({name})"]
+    for d in jax.local_devices():
+        stats = getattr(d, "memory_stats", lambda: None)()
+        if stats:
+            lines.append(
+                f"  {d}: in_use={stats.get('bytes_in_use', 0) / 2**20:.1f}MiB "
+                f"limit={stats.get('bytes_limit', 0) / 2**20:.1f}MiB")
+    msg = "\n".join(lines)
+    print(msg, flush=True)
+    return msg

@@ -1,0 +1,283 @@
+"""Pipeline-parallel tier tests on the 8-device emulated CPU mesh.
+
+Mirrors reference tests (SURVEY.md §4): run_pipeline_parallel_test.py (all
+three schedules on a toy model, loss parity vs single-stage),
+run_dynamic_batchsize_test.py (microbatch calculators), plus mask/position
+utils.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.pipeline_parallel import (
+    ConstantNumMicroBatches,
+    RampupBatchsizeNumMicroBatches,
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+    get_ltor_masks_and_position_ids,
+    split_into_microbatches,
+)
+
+PP = 4
+N_MICRO = 8
+MB = 2
+HIDDEN = 8
+
+
+@pytest.fixture()
+def pp_mesh():
+    parallel_state.destroy_model_parallel()
+    mesh = parallel_state.initialize_model_parallel(1, PP)
+    yield mesh
+    parallel_state.destroy_model_parallel()
+
+
+def _toy_stage_params(key, n_stages):
+    """Each stage: one dense layer [HIDDEN, HIDDEN] (same shape per stage
+    — SPMD requirement, like the reference's toy MyModel)."""
+    keys = jax.random.split(key, n_stages)
+    return {
+        "w": jnp.stack([jax.random.normal(k, (HIDDEN, HIDDEN)) * 0.3
+                        for k in keys]),
+        "b": jnp.zeros((n_stages, HIDDEN)),
+    }
+
+
+def _serial_forward(params, x):
+    h = x
+    for s in range(PP):
+        h = jnp.tanh(h @ params["w"][s] + params["b"][s])
+    return h
+
+
+def _serial_loss(params, microbatches):
+    losses = []
+    for m in range(N_MICRO):
+        x = microbatches["x"][m]
+        y = microbatches["y"][m]
+        out = _serial_forward(params, x)
+        losses.append(jnp.mean((out - y) ** 2))
+    return jnp.mean(jnp.stack(losses))
+
+
+def _make_data():
+    x = jax.random.normal(jax.random.PRNGKey(1), (N_MICRO, MB, HIDDEN))
+    y = jax.random.normal(jax.random.PRNGKey(2), (N_MICRO, MB, HIDDEN))
+    return {"x": x, "y": y}
+
+
+class TestNoPipelining:
+    def test_grad_accumulation_matches_full_batch(self, pp_mesh):
+        params = {"w": jax.random.normal(jax.random.PRNGKey(0), (HIDDEN, HIDDEN)) * 0.3}
+        data = _make_data()
+
+        def fwd(p, mb):
+            out = jnp.tanh(mb["x"] @ p["w"])
+            return jnp.mean((out - mb["y"]) ** 2)
+
+        loss, grads = forward_backward_no_pipelining(
+            fwd, params, data, n_microbatches=N_MICRO)
+
+        def full(p):
+            return jnp.mean(jnp.stack(
+                [fwd(p, jax.tree_util.tree_map(lambda a: a[m], data))
+                 for m in range(N_MICRO)]))
+
+        ref_loss, ref_grads = jax.value_and_grad(full)(params)
+        np.testing.assert_allclose(loss, ref_loss, rtol=1e-5)
+        np.testing.assert_allclose(grads["w"], ref_grads["w"], rtol=1e-4,
+                                   atol=1e-6)
+
+    def test_forward_only(self, pp_mesh):
+        params = {"w": jnp.eye(HIDDEN)}
+        data = _make_data()
+
+        def fwd(p, mb):
+            return jnp.sum(mb["x"] @ p["w"])
+
+        (losses,) = forward_backward_no_pipelining(
+            fwd, params, data, n_microbatches=N_MICRO, forward_only=True)
+        assert losses.shape == (N_MICRO,)
+        np.testing.assert_allclose(losses[0], jnp.sum(data["x"][0]), rtol=1e-5)
+
+
+class TestPipelining1F1B:
+    def _run_pipelined(self, pp_mesh, params, data, forward_only=False):
+        # canonical Megatron layout: each stage owns its own params —
+        # the stacked [PP, ...] tree is sharded over the pipeline axis and
+        # every device sees only its local [1, ...] slice.
+        def stage_fn(p, h, mb):
+            s = parallel_state.get_pipeline_model_parallel_rank()
+            inp = jnp.where(s == 0, mb["x"], h)
+            return jnp.tanh(inp @ p["w"][0] + p["b"][0])
+
+        def loss_fn(y, mb):
+            return jnp.mean((y - mb["y"]) ** 2)
+
+        def run(p, d):
+            return forward_backward_pipelining_without_interleaving(
+                stage_fn, loss_fn, p, d,
+                n_microbatches=N_MICRO, tensor_shape=(MB, HIDDEN),
+                forward_only=forward_only)
+
+        return shard_map(run, mesh=pp_mesh,
+                         in_specs=(P("pipeline"), P()),
+                         out_specs=P() if forward_only else (P(), P("pipeline")),
+                         check_rep=False)(params, data)
+
+    def test_loss_parity_with_serial(self, pp_mesh):
+        # the reference's canonical assertion: pipeline loss == no-pipeline
+        # loss (run_megatron_gpt_pipeline.py / run_pipeline_parallel_test.py)
+        params = _toy_stage_params(jax.random.PRNGKey(0), PP)
+        data = _make_data()
+        (loss,) = self._run_pipelined(pp_mesh, params, data, forward_only=True)
+        np.testing.assert_allclose(loss, _serial_loss(params, data), rtol=1e-5)
+
+    def test_grad_parity_with_serial(self, pp_mesh):
+        params = _toy_stage_params(jax.random.PRNGKey(0), PP)
+        data = _make_data()
+        loss, grads = self._run_pipelined(pp_mesh, params, data)
+        ref_loss, ref_grads = jax.value_and_grad(_serial_loss)(params, data)
+        np.testing.assert_allclose(loss, ref_loss, rtol=1e-5)
+        # each stage's grads live on its own device; serial grads are the
+        # full stack.  The pipelined grads for stage s's slice must match.
+        np.testing.assert_allclose(grads["w"], ref_grads["w"], rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(grads["b"], ref_grads["b"], rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_training_decreases_loss(self, pp_mesh):
+        params = _toy_stage_params(jax.random.PRNGKey(0), PP)
+        data = _make_data()
+        losses = []
+        for _ in range(10):
+            loss, grads = self._run_pipelined(pp_mesh, params, data)
+            params = jax.tree_util.tree_map(
+                lambda p, g: p - 0.5 * g, params, grads)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+
+class TestInterleaved:
+    def test_loss_and_grad_parity(self, pp_mesh):
+        # 2 model chunks per stage -> 8 virtual stages
+        vpp = 2
+        total_virtual = PP * vpp
+        keys = jax.random.split(jax.random.PRNGKey(0), total_virtual)
+        full_w = jnp.stack(
+            [jax.random.normal(k, (HIDDEN, HIDDEN)) * 0.2 for k in keys])
+        data = _make_data()
+
+        # chunked params: device d holds virtual stages d + PP*k, stacked on
+        # a leading vpp axis *per device*; build the stacked global layout
+        # [PP, vpp, ...] and shard over pipeline.
+        chunked = {"w": jnp.stack(
+            [jnp.stack([full_w[d + PP * k] for k in range(vpp)])
+             for d in range(PP)])}
+
+        def chunk_fn(p, h, mb, k):
+            s = parallel_state.get_pipeline_model_parallel_rank()
+            v_first = (s == 0) & (k == 0)
+            inp = jnp.where(v_first, mb["x"], h)
+            return jnp.tanh(inp @ p["w"])
+
+        def loss_fn(y, mb):
+            return jnp.mean((y - mb["y"]) ** 2)
+
+        def run(p, d):
+            p_local = jax.tree_util.tree_map(lambda a: a[0], p)  # [vpp, ...]
+            return forward_backward_pipelining_with_interleaving(
+                chunk_fn, loss_fn, p_local, d,
+                n_microbatches=N_MICRO, num_model_chunks=vpp,
+                tensor_shape=(MB, HIDDEN))
+
+        loss, grads = shard_map(
+            run, mesh=pp_mesh, in_specs=(P("pipeline"), P()),
+            out_specs=(P(), P("pipeline")), check_rep=False)(chunked, data)
+
+        def serial(full_w, d):
+            losses = []
+            for m in range(N_MICRO):
+                h = d["x"][m]
+                for v in range(total_virtual):
+                    h = jnp.tanh(h @ full_w[v])
+                losses.append(jnp.mean((h - d["y"][m]) ** 2))
+            return jnp.mean(jnp.stack(losses))
+
+        ref_loss, ref_gw = jax.value_and_grad(serial)(full_w, data)
+        np.testing.assert_allclose(loss, ref_loss, rtol=1e-5)
+        # out_specs P("pipeline") concatenates each device's [vpp, H, H]
+        # grads into [PP*vpp, H, H]: device d's chunk k is row d*vpp + k,
+        # holding virtual stage d + PP*k.
+        for d in range(PP):
+            for k in range(vpp):
+                np.testing.assert_allclose(
+                    grads["w"][d * vpp + k], ref_gw[d + PP * k],
+                    rtol=1e-4, atol=1e-5)
+
+
+class TestScheduleSelector:
+    def test_selector(self):
+        assert get_forward_backward_func(None, 1) is forward_backward_no_pipelining
+        assert (get_forward_backward_func(None, 4)
+                is forward_backward_pipelining_without_interleaving)
+        assert (get_forward_backward_func(2, 4)
+                is forward_backward_pipelining_with_interleaving)
+
+
+class TestMicrobatchCalculators:
+    def test_constant(self):
+        c = ConstantNumMicroBatches(64, 2, 4)
+        assert c.get() == 8
+        with pytest.raises(ValueError):
+            ConstantNumMicroBatches(65, 2, 4)
+
+    def test_rampup(self):
+        # reference run_dynamic_batchsize_test.py semantics
+        c = RampupBatchsizeNumMicroBatches(
+            start_batch_size=8, batch_size_increment=8, ramup_samples=80,
+            global_batch_size=32, micro_batch_size=2, data_parallel_size=2)
+        assert c.get_current_global_batch_size() == 8
+        c.update(0, True)
+        assert c.get() == 2
+        c.update(40, True)
+        assert c.get_current_global_batch_size() == 16
+        c.update(100, True)
+        assert c.get_current_global_batch_size() == 32
+        assert c.get() == 8
+
+
+class TestLtorMasks:
+    def test_basic_causal(self):
+        data = jnp.array([[5, 3, 9, 3]])
+        am, lm, pid = get_ltor_masks_and_position_ids(data, eod_token=9)
+        assert am.shape == (1, 1, 4, 4)
+        # row i can attend to j <= i  (True = masked out)
+        assert not bool(am[0, 0, 2, 0]) and bool(am[0, 0, 0, 2])
+        np.testing.assert_array_equal(pid[0], [0, 1, 2, 3])
+        np.testing.assert_allclose(lm[0], [1, 1, 1, 1])
+
+    def test_eod_handling(self):
+        data = jnp.array([[5, 9, 7, 8]])
+        am, lm, pid = get_ltor_masks_and_position_ids(
+            data, eod_token=9, reset_position_ids=True,
+            reset_attention_mask=True, eod_mask_loss=True)
+        np.testing.assert_allclose(lm[0], [1, 0, 1, 1])
+        # position ids reset after eod
+        np.testing.assert_array_equal(pid[0], [0, 1, 0, 1])
+        # token 2 (doc 2) cannot attend to token 0 (doc 1)
+        assert bool(am[0, 0, 2, 0])
+        assert not bool(am[0, 0, 3, 2])
+
+    def test_split_into_microbatches(self):
+        batch = {"x": jnp.arange(24.0).reshape(12, 2)}
+        out = split_into_microbatches(batch, 4)
+        assert out["x"].shape == (4, 3, 2)
+        np.testing.assert_array_equal(out["x"][1, 0], batch["x"][3])
