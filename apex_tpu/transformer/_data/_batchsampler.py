@@ -2,8 +2,9 @@
 
 TPU-native port of ``apex.transformer._data._batchsampler``
 (reference _batchsampler.py:38-180): iterate global-batch index lists,
-yielding each data-parallel rank's contiguous (or shuffled) slice.  Pure
-index arithmetic — identical semantics, no torch Sampler base.
+yielding each data-parallel rank's contiguous (or shuffled) slice of a
+``local_minibatch_size = global_batch_size / data_parallel_size`` batch.
+Pure index arithmetic — identical semantics, no torch Sampler base.
 """
 
 from __future__ import annotations
@@ -17,24 +18,24 @@ class MegatronPretrainingSampler:
     (reference _batchsampler.py:38-99)."""
 
     def __init__(self, total_samples: int, consumed_samples: int,
-                 micro_batch_size: int, data_parallel_rank: int,
+                 local_minibatch_size: int, data_parallel_rank: int,
                  data_parallel_size: int, drop_last: bool = True):
         self.total_samples = total_samples
         self.consumed_samples = consumed_samples
-        self.micro_batch_size = micro_batch_size
+        self.local_minibatch_size = local_minibatch_size
         self.data_parallel_rank = data_parallel_rank
         self.data_parallel_size = data_parallel_size
-        self.micro_batch_times_data_parallel_size = (
-            micro_batch_size * data_parallel_size)
+        self.local_minibatch_times_data_parallel_size = (
+            local_minibatch_size * data_parallel_size)
         self.drop_last = drop_last
         if total_samples <= 0:
             raise ValueError(f"no sample to consume: {total_samples}")
         if consumed_samples >= total_samples:
             raise ValueError(
                 f"no samples left to consume: {consumed_samples}, {total_samples}")
-        if micro_batch_size <= 0:
-            raise ValueError(f"micro_batch_size size must be greater than 0, "
-                             f"but {micro_batch_size}")
+        if local_minibatch_size <= 0:
+            raise ValueError(f"local minibatch size must be greater than 0: "
+                             f"{local_minibatch_size}")
         if data_parallel_size <= 0:
             raise ValueError("data parallel size must be greater than 0")
         if data_parallel_rank >= data_parallel_size:
@@ -46,15 +47,15 @@ class MegatronPretrainingSampler:
         return self.total_samples
 
     def get_start_end_idx(self):
-        start_idx = self.data_parallel_rank * self.micro_batch_size
-        end_idx = start_idx + self.micro_batch_size
+        start_idx = self.data_parallel_rank * self.local_minibatch_size
+        end_idx = start_idx + self.local_minibatch_size
         return start_idx, end_idx
 
     def __iter__(self) -> Iterator[List[int]]:
         batch: List[int] = []
         for idx in range(self.consumed_samples, self.total_samples):
             batch.append(idx)
-            if len(batch) == self.micro_batch_times_data_parallel_size:
+            if len(batch) == self.local_minibatch_times_data_parallel_size:
                 start_idx, end_idx = self.get_start_end_idx()
                 yield batch[start_idx:end_idx]
                 batch = []
@@ -68,22 +69,22 @@ class MegatronPretrainingRandomSampler:
     (reference _batchsampler.py:102-180)."""
 
     def __init__(self, total_samples: int, consumed_samples: int,
-                 micro_batch_size: int, data_parallel_rank: int,
+                 local_minibatch_size: int, data_parallel_rank: int,
                  data_parallel_size: int):
         self.total_samples = total_samples
         self.consumed_samples = consumed_samples
-        self.micro_batch_size = micro_batch_size
+        self.local_minibatch_size = local_minibatch_size
         self.data_parallel_rank = data_parallel_rank
         self.data_parallel_size = data_parallel_size
-        self.micro_batch_times_data_parallel_size = (
-            micro_batch_size * data_parallel_size)
+        self.local_minibatch_times_data_parallel_size = (
+            local_minibatch_size * data_parallel_size)
         self.last_batch_size = (
-            self.total_samples % self.micro_batch_times_data_parallel_size)
+            self.total_samples % self.local_minibatch_times_data_parallel_size)
         if total_samples <= 0:
             raise ValueError(f"no sample to consume: {total_samples}")
-        if micro_batch_size <= 0:
-            raise ValueError(f"micro_batch_size size must be greater than 0, "
-                             f"but {micro_batch_size}")
+        if local_minibatch_size <= 0:
+            raise ValueError(f"local minibatch size must be greater than 0: "
+                             f"{local_minibatch_size}")
         if data_parallel_size <= 0:
             raise ValueError("data parallel size must be greater than 0")
         if data_parallel_rank >= data_parallel_size:
@@ -99,13 +100,13 @@ class MegatronPretrainingRandomSampler:
         self.epoch = self.consumed_samples // active_total_samples
         current_epoch_samples = self.consumed_samples % active_total_samples
         if (current_epoch_samples
-                % self.micro_batch_times_data_parallel_size != 0):
+                % self.local_minibatch_times_data_parallel_size != 0):
             raise RuntimeError("consumed samples must align to a global batch")
 
         # data sharding and random sampling
         bucket_size = ((self.total_samples
-                        // self.micro_batch_times_data_parallel_size)
-                       * self.micro_batch_size)
+                        // self.local_minibatch_times_data_parallel_size)
+                       * self.local_minibatch_size)
         bucket_offset = current_epoch_samples // self.data_parallel_size
         start_idx = self.data_parallel_rank * bucket_size
 
@@ -117,7 +118,7 @@ class MegatronPretrainingRandomSampler:
         batch: List[int] = []
         for idx in idx_range:
             batch.append(idx)
-            if len(batch) == self.micro_batch_size:
-                self.consumed_samples += self.micro_batch_times_data_parallel_size
+            if len(batch) == self.local_minibatch_size:
+                self.consumed_samples += self.local_minibatch_times_data_parallel_size
                 yield batch
                 batch = []

@@ -31,22 +31,24 @@ class GradScaler(LossScaler):
     model_parallel_axes: Sequence[str] = (PIPELINE_AXIS, TENSOR_AXIS)
 
     def found_inf(self, grads) -> jnp.ndarray:
-        """True if any grad anywhere in the MP block is non-finite.  Must run
-        inside a region binding the model-parallel axes; falls back to the
-        local check outside one."""
-        local = jnp.logical_not(all_finite(grads))
-        try:
-            # max over the MP block: any rank's overflow poisons all
-            return jax.lax.pmax(local.astype(jnp.int32),
-                                self.model_parallel_axes).astype(bool)
-        except NameError:
-            return local
+        """True if any grad anywhere in the MP block is non-finite.  Reduces
+        over whichever of the model-parallel axes are bound in the current
+        region (TP-only regions still agree across "tensor"); purely local
+        outside any."""
+        from apex_tpu.utils.tree import tree_isfinite
+
+        verdict = jnp.logical_not(tree_isfinite(grads)).astype(jnp.int32)
+        for axis in self.model_parallel_axes:
+            try:
+                verdict = jax.lax.pmax(verdict, axis)
+            except NameError:
+                continue  # axis not bound here
+        return verdict.astype(bool)
 
 
 def all_finite(tree) -> jnp.ndarray:
-    """Single fused all-finite reduction over a pytree."""
-    leaves = jax.tree_util.tree_leaves(tree)
-    if not leaves:
-        return jnp.array(True)
-    finite = [jnp.all(jnp.isfinite(l)) for l in leaves]
-    return jnp.stack(finite).all()
+    """Alias of :func:`apex_tpu.utils.tree.tree_isfinite` (one fused
+    all-finite reduction, floating leaves only)."""
+    from apex_tpu.utils.tree import tree_isfinite
+
+    return tree_isfinite(tree)

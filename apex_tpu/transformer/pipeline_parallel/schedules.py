@@ -49,7 +49,8 @@ from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
 )
 
 StageFn = Callable[[Any, jnp.ndarray, Any], jnp.ndarray]
-LossFn = Callable[[jnp.ndarray, Any], jnp.ndarray]
+# loss_fn receives the stage-local params so the last stage can apply its head
+LossFn = Callable[[Any, jnp.ndarray, Any], jnp.ndarray]
 
 
 def _get_microbatch(microbatches, m):
@@ -63,10 +64,14 @@ def _get_microbatch(microbatches, m):
 
 def forward_backward_no_pipelining(
     forward_step_fn: Callable[[Any, Any], jnp.ndarray],
-    params: Any,
-    microbatches: Any,
+    loss_fn: Optional[LossFn] = None,
+    params: Any = None,
+    microbatches: Any = None,
     *,
     n_microbatches: int,
+    tensor_shape: Optional[Sequence[int]] = None,
+    dtype=jnp.float32,
+    axis_name: str = PIPELINE_AXIS,
     forward_only: bool = False,
     remat: bool = False,
 ):
@@ -74,11 +79,29 @@ def forward_backward_no_pipelining(
     (reference fwd_bwd_no_pipelining.py:29-91: grad-accum under
     ``model.no_sync`` then a final sync step).
 
-    ``forward_step_fn(params, microbatch) -> scalar loss``.  Returns
-    ``(mean_loss, grads)`` — grads averaged over microbatches — or
-    ``(losses,)`` stacked if ``forward_only``.
+    Two calling conventions, so :func:`get_forward_backward_func` is
+    swappable across pipeline sizes exactly like the reference selector:
+
+    * simple: ``forward_step_fn(params, microbatch) -> scalar loss`` with
+      ``loss_fn=None`` (pass params/microbatches positionally or by name);
+    * schedule-compatible: the pipelined ``(stage_fn, loss_fn, params,
+      microbatches, ..., tensor_shape=...)`` signature — the stage runs as
+      the single stage and ``loss_fn`` applies the head.
+
+    Returns ``(mean_loss, grads)`` — grads averaged over microbatches — or
+    ``(mean_loss,)`` if ``forward_only`` (same shape as the pipelined
+    schedules).
     """
-    step = forward_step_fn
+    del axis_name  # single-stage: no pipeline collective needed
+    if loss_fn is not None:
+        if tensor_shape is None:
+            raise ValueError("tensor_shape is required with a loss_fn")
+        buf0 = jnp.zeros(tuple(tensor_shape), dtype)
+
+        def step(p, mb):
+            return loss_fn(p, forward_step_fn(p, buf0, mb), mb)
+    else:
+        step = forward_step_fn
     if remat:
         step = jax.checkpoint(step)
 
@@ -87,7 +110,7 @@ def forward_backward_no_pipelining(
             return None, step(params, _get_microbatch(microbatches, m))
 
         _, losses = jax.lax.scan(body, None, jnp.arange(n_microbatches))
-        return (losses,)
+        return (jnp.mean(losses),)
 
     grad_fn = jax.value_and_grad(step)
 
@@ -134,7 +157,7 @@ def _pipelined_loss(
         y = fn(params, buf, mb)
         valid = (m >= 0) & (m < n_microbatches)
         step_loss = jnp.where(valid & is_last,
-                              loss_fn(y, mb).astype(jnp.float32), 0.0)
+                              loss_fn(params, y, mb).astype(jnp.float32), 0.0)
         # transfer to the next stage; stage 0's incoming slot carries
         # wrap-around garbage it never reads (its stage_fn embeds from mb)
         buf = send_recv_next(y, axis_name)
@@ -171,8 +194,9 @@ def forward_backward_pipelining_without_interleaving(
 
     ``stage_fn(params, hidden_in, microbatch) -> hidden_out`` — the user's
     per-stage block; it must select embedding/identity input by stage (see
-    module docstring).  ``loss_fn(hidden_out, microbatch) -> scalar`` —
-    evaluated on the last stage only.  ``tensor_shape`` is the inter-stage
+    module docstring).  ``loss_fn(params, hidden_out, microbatch) ->
+    scalar`` — evaluated on the last stage only (``params`` is that stage's
+    local tree, carrying the head weights).  ``tensor_shape`` is the inter-stage
     activation shape, exactly the reference's ``tensor_shape`` argument
     (seq, microbatch, hidden) passed to its p2p layer.
 
@@ -230,7 +254,7 @@ def _interleaved_loss(
             valid = (m >= 0) & (m < n_microbatches)
             if k == vpp - 1:
                 loss_sum = loss_sum + jnp.where(
-                    valid & is_last, loss_fn(y, mb).astype(jnp.float32), 0.0)
+                    valid & is_last, loss_fn(pk, y, mb).astype(jnp.float32), 0.0)
             ys.append(y)
         y_stack = jnp.stack(ys)
         r = send_recv_next(y_stack, axis_name)  # ring by device

@@ -140,7 +140,6 @@ def get_ltor_masks_and_position_ids(
     if reset_position_ids or reset_attention_mask:
         is_eod = data == eod_token
         # document id of each position = number of eods strictly before it
-        doc_id = jnp.cumsum(is_eod, axis=1) - jnp.where(is_eod, 1, 0)
         doc_id = jnp.cumsum(jnp.pad(is_eod[:, :-1], ((0, 0), (1, 0))), axis=1)
         if reset_attention_mask:
             same_doc = doc_id[:, None, :, None] == doc_id[:, None, None, :]

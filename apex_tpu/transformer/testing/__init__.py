@@ -1,0 +1,18 @@
+"""apex_tpu.transformer.testing — reference Megatron models and helpers
+(reference apex/transformer/testing/)."""
+
+from apex_tpu.transformer.testing.standalone_bert import (  # noqa: F401
+    BertConfig,
+    BertModel,
+    bert_model_provider,
+)
+from apex_tpu.transformer.testing.standalone_gpt import (  # noqa: F401
+    GPTConfig,
+    GPTModel,
+    ParallelAttention,
+    ParallelMLP,
+    ParallelTransformer,
+    ParallelTransformerLayer,
+    gpt_model_provider,
+    make_gpt_stage_fns,
+)

@@ -1,0 +1,158 @@
+"""Standalone Megatron BERT — the reference testing model, TPU-native.
+
+Re-design of ``apex.transformer.testing.standalone_bert``
+(reference standalone_bert.py: BertModel :101, bert_model_provider :215).
+
+Shares the parallel transformer body with
+:mod:`apex_tpu.transformer.testing.standalone_gpt` (as the reference shares
+ParallelTransformer), with BERT's differences: token-type embeddings,
+*padding* (bidirectional) attention-mask semantics, a tanh pooler over the
+first token, the tied MLM head with its own layernorm, and the binary
+(NSP) head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import AttnMaskType, layer_norm
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.tensor_parallel import (
+    VocabParallelEmbedding,
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.testing.standalone_gpt import (
+    GPTConfig,
+    ParallelTransformer,
+    _normal_init,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig(GPTConfig):
+    """BERT reuses the network-size config plus token types / NSP head."""
+
+    num_tokentypes: int = 2
+    add_binary_head: bool = True
+
+
+class BertModel:
+    """Reference BertModel (standalone_bert.py:101-213)."""
+
+    def __init__(self, cfg: BertConfig, num_layers: Optional[int] = None,
+                 pre_process: bool = True, post_process: bool = True):
+        self.cfg = cfg
+        self.pre_process = pre_process
+        self.post_process = post_process
+        self.embedding = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size,
+            init_method=_normal_init(cfg.init_method_std), tp_size=cfg.tp_size)
+        # BERT attention is bidirectional: overwrite the body's mask type
+        self.transformer = ParallelTransformer(cfg, num_layers)
+        for_softmax = self.transformer.layer.attention.softmax
+        for_softmax.attn_mask_type = AttnMaskType.padding
+
+    def init_master(self, key):
+        k1, k2, k3, k4, k5, k6 = jax.random.split(key, 6)
+        h = self.cfg.hidden_size
+        std = self.cfg.init_method_std
+        p: dict = {"transformer": self.transformer.init_master(k3)}
+        if self.pre_process:
+            p["embedding"] = self.embedding.init_master(k1)
+            p["position_embeddings"] = {
+                "weight": jax.random.normal(
+                    k2, (self.cfg.max_position_embeddings, h)) * std}
+            if self.cfg.num_tokentypes > 0:
+                p["tokentype_embeddings"] = {
+                    "weight": jax.random.normal(
+                        k4, (self.cfg.num_tokentypes, h)) * std}
+        if self.post_process:
+            if not self.pre_process:
+                p["embedding"] = self.embedding.init_master(k1)
+            # lm head: dense + LN over hidden before the tied projection
+            # (reference BertLmHead standalone_bert.py:40-72)
+            p["lm_head"] = {
+                "dense": {"weight": jax.random.normal(k5, (h, h)) * std,
+                          "bias": jnp.zeros((h,))},
+                "layernorm": {"weight": jnp.ones((h,)), "bias": jnp.zeros((h,))},
+                "bias": jnp.zeros((self.embedding.num_embeddings_per_partition,)),
+            }
+            if self.cfg.add_binary_head:
+                p["pooler"] = {"weight": jax.random.normal(k6, (h, h)) * std,
+                               "bias": jnp.zeros((h,))}
+                p["binary_head"] = {"weight": jnp.zeros((2, h)),
+                                    "bias": jnp.zeros((2,))}
+        return p
+
+    def shard_master(self, master, rank):
+        p = dict(master)
+        if "embedding" in master:
+            p["embedding"] = self.embedding.shard_master(master["embedding"], rank)
+        if "lm_head" in master:
+            lm = dict(master["lm_head"])
+            n = self.embedding.num_embeddings_per_partition
+            # the lm bias is vocab-parallel like the tied embedding; a master
+            # built at tp=1 carries the full vocab-length bias — shard it
+            full = master["lm_head"]["bias"]
+            lm["bias"] = (full[rank * n:(rank + 1) * n]
+                          if full.shape[0] != n else full)
+            p["lm_head"] = lm
+        p["transformer"] = self.transformer.shard_master(master["transformer"],
+                                                         rank)
+        return p
+
+    def embed(self, params, tokens, tokentype_ids=None):
+        h = self.embedding.apply(params["embedding"], tokens)
+        pos = params["position_embeddings"]["weight"][:tokens.shape[1]]
+        h = h + pos[None]
+        if tokentype_ids is not None and "tokentype_embeddings" in params:
+            h = h + params["tokentype_embeddings"]["weight"][tokentype_ids]
+        return h.astype(self.cfg.compute_dtype)
+
+    def lm_logits_local(self, params, h):
+        """Sharded MLM logits via the tied embedding + head transform."""
+        lm = params["lm_head"]
+        h = h @ lm["dense"]["weight"].T + lm["dense"]["bias"]
+        h = jax.nn.gelu(h, approximate=True)
+        h = layer_norm(h, lm["layernorm"]["weight"], lm["layernorm"]["bias"],
+                       eps=self.cfg.layernorm_epsilon)
+        w = params["embedding"]["weight"]
+        logits = jax.lax.dot_general(
+            h, w, (((h.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return logits + lm["bias"]
+
+    def apply(self, params, tokens, attention_mask=None, tokentype_ids=None,
+              lm_labels=None):
+        """Returns ``(lm_losses_or_logits, binary_logits)``."""
+        h = self.embed(params, tokens, tokentype_ids)
+        # padding mask [b, 1, 1, s] -> broadcast [b, 1, s, s], True = masked
+        am = None
+        if attention_mask is not None:
+            am = ~attention_mask[:, None, None, :].astype(bool)
+        h = self.transformer.apply(params["transformer"], h, am)
+
+        binary_logits = None
+        if self.cfg.add_binary_head and "binary_head" in params:
+            pooled = jnp.tanh(
+                h[:, 0] @ params["pooler"]["weight"].T + params["pooler"]["bias"])
+            binary_logits = (pooled @ params["binary_head"]["weight"].T
+                             + params["binary_head"]["bias"])
+
+        logits_local = self.lm_logits_local(params, h)
+        if lm_labels is None:
+            return logits_local, binary_logits
+        losses = vocab_parallel_cross_entropy(logits_local, lm_labels)
+        return losses, binary_logits
+
+    __call__ = apply
+
+
+def bert_model_provider(cfg: BertConfig, pre_process: bool = True,
+                        post_process: bool = True) -> BertModel:
+    """Reference bert_model_provider (standalone_bert.py:215)."""
+    return BertModel(cfg, pre_process=pre_process, post_process=post_process)

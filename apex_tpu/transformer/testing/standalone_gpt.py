@@ -1,0 +1,348 @@
+"""Standalone Megatron GPT — the reference testing model, TPU-native.
+
+Re-design of ``apex.transformer.testing.standalone_gpt``
+(reference standalone_gpt.py: GPTModel :1426, gpt_model_provider :1502,
+ParallelMLP :234, ParallelAttention :283, ParallelTransformerLayer :575,
+ParallelTransformer :711).
+
+Structure parity (pre-LN GPT-2 architecture, untied pieces noted):
+
+* vocab-parallel word embedding + learned position embedding,
+* N × ParallelTransformerLayer:
+    LN → ParallelAttention (ColumnParallel QKV → causal fused softmax →
+    RowParallel proj) → residual → LN → ParallelMLP (ColumnParallel h→4h →
+    GELU → RowParallel 4h→h) → residual,
+* final LN, logits through the (vocab-parallel) word-embedding transpose,
+* loss = vocab-parallel cross entropy.
+
+TPU-native choices: layers are stacked and applied with ``lax.scan``
+(constant compile time in depth); attention softmax is the fused
+:class:`apex_tpu.ops.FusedScaleMaskSoftmax` causal kernel; all TP
+communication comes from the plain-collective mappings, so the backward
+all-reduces are derived by AD.  ``apply`` must run inside a region binding
+the "tensor" axis.  Dropout is deterministic-off by default so pipeline /
+TP parity tests are exact (reference tests run in eval-determinism too).
+
+For pipeline parallelism, :func:`gpt_stage_fn` / :func:`gpt_loss_fn` adapt
+the model to the compiled schedules: stage 0 embeds, the last stage applies
+the head — selected with ``jnp.where`` on the stage index (SPMD-uniform).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import (
+    AttnMaskType,
+    FusedScaleMaskSoftmax,
+    layer_norm,
+)
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    vocab_parallel_cross_entropy,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    """Network-size args (reference testing/arguments.py network-size group)."""
+
+    num_layers: int = 2
+    hidden_size: int = 64
+    num_attention_heads: int = 4
+    vocab_size: int = 128
+    max_position_embeddings: int = 64
+    ffn_hidden_size: Optional[int] = None
+    layernorm_epsilon: float = 1e-5
+    init_method_std: float = 0.02
+    fp16: bool = False
+    bf16: bool = False
+    tp_size: int = 1
+
+    @property
+    def ffn(self) -> int:
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+    @property
+    def compute_dtype(self):
+        if self.bf16:
+            return jnp.bfloat16
+        if self.fp16:
+            return jnp.float16
+        return jnp.float32
+
+    @property
+    def kv_channels(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def _normal_init(std):
+    def init(key, shape):
+        return jax.random.normal(key, shape) * std
+
+    return init
+
+
+class ParallelAttention:
+    """Causal self-attention (reference standalone_gpt.py:283-546)."""
+
+    def __init__(self, cfg: GPTConfig):
+        self.cfg = cfg
+        self.qkv = ColumnParallelLinear(
+            cfg.hidden_size, 3 * cfg.hidden_size, gather_output=False,
+            init_method=_normal_init(cfg.init_method_std), tp_size=cfg.tp_size)
+        self.proj = RowParallelLinear(
+            cfg.hidden_size, cfg.hidden_size, input_is_parallel=True,
+            init_method=_normal_init(cfg.init_method_std), tp_size=cfg.tp_size)
+        self.softmax = FusedScaleMaskSoftmax(
+            input_in_fp16=cfg.fp16, input_in_bf16=cfg.bf16,
+            attn_mask_type=AttnMaskType.causal,
+            scaled_masked_softmax_fusion=True, softmax_in_fp32=True,
+            scale=None)
+        self.np_local = cfg.num_attention_heads // cfg.tp_size
+
+    def init_master(self, key):
+        k1, k2 = jax.random.split(key)
+        return {"qkv": self.qkv.init_master(k1), "proj": self.proj.init_master(k2)}
+
+    def shard_master(self, master, rank):
+        return {"qkv": self.qkv.shard_master(master["qkv"], rank),
+                "proj": self.proj.shard_master(master["proj"], rank)}
+
+    def apply(self, params, h, attention_mask=None):
+        # h: [b, s, hidden]
+        cfg = self.cfg
+        b, s, _ = h.shape
+        qkv = self.qkv.apply(params["qkv"], h)  # [b, s, 3*hidden/tp]
+        qkv = qkv.reshape(b, s, self.np_local, 3 * cfg.kv_channels)
+        q, k, v = jnp.split(qkv, 3, axis=-1)  # each [b, s, np, hn]
+        # scores [b, np, s, s]; scale 1/sqrt(hn) matches norm_factor (:389)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.kv_channels, jnp.float32))
+        scores = jnp.einsum("bqnh,bknh->bnqk", q, k,
+                            preferred_element_type=jnp.float32)
+        scores = (scores * scale).astype(h.dtype)
+        probs = self.softmax(scores, attention_mask)
+        ctx = jnp.einsum("bnqk,bknh->bqnh", probs, v,
+                         preferred_element_type=jnp.float32).astype(h.dtype)
+        ctx = ctx.reshape(b, s, self.np_local * cfg.kv_channels)
+        return self.proj.apply(params["proj"], ctx)
+
+
+class ParallelMLP:
+    """h → 4h → h with fused GELU (reference standalone_gpt.py:234-281)."""
+
+    def __init__(self, cfg: GPTConfig):
+        self.cfg = cfg
+        self.dense_h_to_4h = ColumnParallelLinear(
+            cfg.hidden_size, cfg.ffn, gather_output=False,
+            init_method=_normal_init(cfg.init_method_std), tp_size=cfg.tp_size)
+        self.dense_4h_to_h = RowParallelLinear(
+            cfg.ffn, cfg.hidden_size, input_is_parallel=True,
+            init_method=_normal_init(cfg.init_method_std), tp_size=cfg.tp_size)
+
+    def init_master(self, key):
+        k1, k2 = jax.random.split(key)
+        return {"dense_h_to_4h": self.dense_h_to_4h.init_master(k1),
+                "dense_4h_to_h": self.dense_4h_to_h.init_master(k2)}
+
+    def shard_master(self, master, rank):
+        return {
+            "dense_h_to_4h": self.dense_h_to_4h.shard_master(
+                master["dense_h_to_4h"], rank),
+            "dense_4h_to_h": self.dense_4h_to_h.shard_master(
+                master["dense_4h_to_h"], rank),
+        }
+
+    def apply(self, params, h):
+        inter = self.dense_h_to_4h.apply(params["dense_h_to_4h"], h)
+        inter = jax.nn.gelu(inter, approximate=True)  # bias_gelu fusion (:250)
+        return self.dense_4h_to_h.apply(params["dense_4h_to_h"], inter)
+
+
+class ParallelTransformerLayer:
+    """Pre-LN block (reference standalone_gpt.py:575-709)."""
+
+    def __init__(self, cfg: GPTConfig):
+        self.cfg = cfg
+        self.attention = ParallelAttention(cfg)
+        self.mlp = ParallelMLP(cfg)
+
+    def init_master(self, key):
+        k1, k2 = jax.random.split(key)
+        h = self.cfg.hidden_size
+        return {
+            "input_layernorm": {"weight": jnp.ones((h,)), "bias": jnp.zeros((h,))},
+            "attention": self.attention.init_master(k1),
+            "post_attention_layernorm": {"weight": jnp.ones((h,)),
+                                         "bias": jnp.zeros((h,))},
+            "mlp": self.mlp.init_master(k2),
+        }
+
+    def shard_master(self, master, rank):
+        return {
+            "input_layernorm": master["input_layernorm"],
+            "attention": self.attention.shard_master(master["attention"], rank),
+            "post_attention_layernorm": master["post_attention_layernorm"],
+            "mlp": self.mlp.shard_master(master["mlp"], rank),
+        }
+
+    def apply(self, params, h, attention_mask=None):
+        eps = self.cfg.layernorm_epsilon
+        ln1 = layer_norm(h, params["input_layernorm"]["weight"],
+                         params["input_layernorm"]["bias"], eps=eps)
+        h = h + self.attention.apply(params["attention"], ln1, attention_mask)
+        ln2 = layer_norm(h, params["post_attention_layernorm"]["weight"],
+                         params["post_attention_layernorm"]["bias"], eps=eps)
+        return h + self.mlp.apply(params["mlp"], ln2)
+
+
+class ParallelTransformer:
+    """Stack of layers applied with lax.scan (reference :711-1040 keeps a
+    ModuleList; scanning is the compile-time-friendly TPU equivalent)."""
+
+    def __init__(self, cfg: GPTConfig, num_layers: Optional[int] = None):
+        self.cfg = cfg
+        self.num_layers = num_layers if num_layers is not None else cfg.num_layers
+        self.layer = ParallelTransformerLayer(cfg)
+
+    def init_master(self, key):
+        keys = jax.random.split(key, self.num_layers)
+        layers = [self.layer.init_master(k) for k in keys]
+        return {"layers": jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *layers)}
+
+    def shard_master(self, master, rank):
+        # shard each stacked leaf layer-wise
+        def shard(stacked):
+            return jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs),
+                *[self.layer.shard_master(
+                    jax.tree_util.tree_map(lambda a: a[i], stacked), rank)
+                  for i in range(self.num_layers)])
+
+        return {"layers": shard(master["layers"])}
+
+    def apply(self, params, h, attention_mask=None):
+        def body(carry, layer_params):
+            return self.layer.apply(layer_params, carry, attention_mask), None
+
+        h, _ = jax.lax.scan(body, h, params["layers"])
+        return h
+
+
+class GPTModel:
+    """Reference GPTModel (standalone_gpt.py:1426-1500): embeddings +
+    transformer + tied LM head."""
+
+    def __init__(self, cfg: GPTConfig, num_layers: Optional[int] = None,
+                 pre_process: bool = True, post_process: bool = True):
+        self.cfg = cfg
+        self.pre_process = pre_process
+        self.post_process = post_process
+        self.embedding = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size,
+            init_method=_normal_init(cfg.init_method_std), tp_size=cfg.tp_size)
+        self.transformer = ParallelTransformer(cfg, num_layers)
+
+    def init_master(self, key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        p = {"transformer": self.transformer.init_master(k3)}
+        if self.pre_process:
+            p["embedding"] = self.embedding.init_master(k1)
+            p["position_embeddings"] = {
+                "weight": jax.random.normal(
+                    k2, (self.cfg.max_position_embeddings,
+                         self.cfg.hidden_size)) * self.cfg.init_method_std}
+        if self.post_process:
+            h = self.cfg.hidden_size
+            p["final_layernorm"] = {"weight": jnp.ones((h,)),
+                                    "bias": jnp.zeros((h,))}
+            if not self.pre_process:
+                # untied stage: own copy of the word embedding for the head
+                p["embedding"] = self.embedding.init_master(k1)
+        return p
+
+    def shard_master(self, master, rank):
+        p = {"transformer": self.transformer.shard_master(
+            master["transformer"], rank)}
+        if "embedding" in master:
+            p["embedding"] = self.embedding.shard_master(master["embedding"], rank)
+        if "position_embeddings" in master:
+            p["position_embeddings"] = master["position_embeddings"]
+        if "final_layernorm" in master:
+            p["final_layernorm"] = master["final_layernorm"]
+        return p
+
+    def embed(self, params, tokens):
+        h = self.embedding.apply(params["embedding"], tokens)
+        pos = params["position_embeddings"]["weight"][:tokens.shape[1]]
+        return (h + pos[None]).astype(self.cfg.compute_dtype)
+
+    def head_logits_local(self, params, h):
+        """Sharded logits [b, s, vocab/tp] through the tied embedding
+        (reference post_language_model_processing / parallel_lm_logits)."""
+        h = layer_norm(h, params["final_layernorm"]["weight"],
+                       params["final_layernorm"]["bias"],
+                       eps=self.cfg.layernorm_epsilon)
+        w = params["embedding"]["weight"]  # [vocab/tp, hidden]
+        return jax.lax.dot_general(
+            h, w, (((h.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    def apply(self, params, tokens, labels=None, attention_mask=None):
+        """Full forward.  With ``labels`` returns per-token losses
+        (reference GPTModel.forward returning CE loss); otherwise sharded
+        logits."""
+        h = self.embed(params, tokens)
+        h = self.transformer.apply(params["transformer"], h, attention_mask)
+        logits_local = self.head_logits_local(params, h)
+        if labels is None:
+            return logits_local
+        return vocab_parallel_cross_entropy(logits_local, labels)
+
+    __call__ = apply
+
+
+def gpt_model_provider(cfg: GPTConfig, pre_process: bool = True,
+                       post_process: bool = True) -> GPTModel:
+    """Reference gpt_model_provider (standalone_gpt.py:1502)."""
+    return GPTModel(cfg, pre_process=pre_process, post_process=post_process)
+
+
+# --- pipeline adaptation ----------------------------------------------------
+
+
+def make_gpt_stage_fns(cfg: GPTConfig, n_stages: int
+                       ) -> Tuple[Any, Any]:
+    """Split a GPT into ``n_stages`` pipeline stages for the compiled
+    schedules (reference build_model pre/post_process flags per stage,
+    schedules/common.py:18-106).
+
+    Every stage holds the same param structure — embedding, L/p layers, and
+    head — but only the first uses the embedding and only the last the head
+    (where-masked).  Returns ``(stage_fn, loss_fn)`` for
+    ``forward_backward_pipelining_without_interleaving``; microbatches are
+    dicts with "tokens" and "labels".
+    """
+    if cfg.num_layers % n_stages != 0:
+        raise ValueError("num_layers must divide evenly into stages")
+    model = GPTModel(cfg, num_layers=cfg.num_layers // n_stages)
+
+    def stage_fn(params, h_in, mb):
+        s = parallel_state.get_pipeline_model_parallel_rank()
+        embedded = model.embed(params, mb["tokens"])
+        h = jnp.where(s == 0, embedded, h_in.astype(embedded.dtype))
+        return model.transformer.apply(params["transformer"], h)
+
+    def loss_fn(params, h_out, mb):
+        logits_local = model.head_logits_local(params, h_out)
+        return jnp.mean(vocab_parallel_cross_entropy(logits_local, mb["labels"]))
+
+    return stage_fn, loss_fn

@@ -83,7 +83,7 @@ class TestNoPipelining:
             return jnp.mean((out - mb["y"]) ** 2)
 
         loss, grads = forward_backward_no_pipelining(
-            fwd, params, data, n_microbatches=N_MICRO)
+            fwd, params=params, microbatches=data, n_microbatches=N_MICRO)
 
         def full(p):
             return jnp.mean(jnp.stack(
@@ -102,10 +102,39 @@ class TestNoPipelining:
         def fwd(p, mb):
             return jnp.sum(mb["x"] @ p["w"])
 
-        (losses,) = forward_backward_no_pipelining(
-            fwd, params, data, n_microbatches=N_MICRO, forward_only=True)
-        assert losses.shape == (N_MICRO,)
-        np.testing.assert_allclose(losses[0], jnp.sum(data["x"][0]), rtol=1e-5)
+        (loss,) = forward_backward_no_pipelining(
+            fwd, params=params, microbatches=data, n_microbatches=N_MICRO,
+            forward_only=True)
+        ref = jnp.mean(jnp.stack(
+            [jnp.sum(data["x"][m]) for m in range(N_MICRO)]))
+        np.testing.assert_allclose(loss, ref, rtol=1e-5)
+
+    def test_schedule_compatible_signature(self, pp_mesh):
+        # the unified (stage_fn, loss_fn, ...) convention at pp=1
+        params = {"w": jax.random.normal(jax.random.PRNGKey(0),
+                                         (HIDDEN, HIDDEN)) * 0.3}
+        data = _make_data()
+
+        def stage_fn(p, h, mb):
+            return jnp.tanh(mb["x"] @ p["w"])
+
+        def loss_fn(p, y, mb):
+            return jnp.mean((y - mb["y"]) ** 2)
+
+        loss, grads = forward_backward_no_pipelining(
+            stage_fn, loss_fn, params, data, n_microbatches=N_MICRO,
+            tensor_shape=(MB, HIDDEN))
+
+        def full(p):
+            return jnp.mean(jnp.stack(
+                [loss_fn(p, stage_fn(p, None, jax.tree_util.tree_map(
+                    lambda a: a[m], data)), jax.tree_util.tree_map(
+                    lambda a: a[m], data)) for m in range(N_MICRO)]))
+
+        ref_loss, ref_grads = jax.value_and_grad(full)(params)
+        np.testing.assert_allclose(loss, ref_loss, rtol=1e-5)
+        np.testing.assert_allclose(grads["w"], ref_grads["w"], rtol=1e-4,
+                                   atol=1e-6)
 
 
 class TestPipelining1F1B:
@@ -118,7 +147,7 @@ class TestPipelining1F1B:
             inp = jnp.where(s == 0, mb["x"], h)
             return jnp.tanh(inp @ p["w"][0] + p["b"][0])
 
-        def loss_fn(y, mb):
+        def loss_fn(p, y, mb):
             return jnp.mean((y - mb["y"]) ** 2)
 
         def run(p, d):
@@ -188,7 +217,7 @@ class TestInterleaved:
             inp = jnp.where(v_first, mb["x"], h)
             return jnp.tanh(inp @ p["w"])
 
-        def loss_fn(y, mb):
+        def loss_fn(p, y, mb):
             return jnp.mean((y - mb["y"]) ** 2)
 
         def run(p, d):
