@@ -1,0 +1,15 @@
+"""apex_tpu.models — reference workloads (ResNet for the imagenet/amp path,
+Megatron GPT/BERT re-exported from transformer.testing)."""
+
+from apex_tpu.models.resnet import (  # noqa: F401
+    ResNet,
+    ResNetConfig,
+    resnet18_config,
+    resnet50_config,
+)
+from apex_tpu.transformer.testing import (  # noqa: F401
+    BertConfig,
+    BertModel,
+    GPTConfig,
+    GPTModel,
+)

@@ -1,0 +1,178 @@
+"""ResNet (v1.5) — the reference's canonical amp+DDP workload.
+
+TPU-native implementation of the model behind
+``examples/imagenet/main_amp.py`` (the reference trains torchvision
+ResNet-50; its L1 tier cross-products opt-levels over it, SURVEY.md §4).
+
+TPU-first choices: NHWC layout (channels-last is the native TPU conv
+layout — the reference gains the same from ``--channels-last``),
+``lax.conv_general_dilated`` onto the MXU with fp32 accumulation, BN as
+:func:`apex_tpu.parallel.sync_batch_norm` so the same model runs
+single-chip or data-parallel (SyncBN over the mesh "data" axis =
+``--sync_bn``).  Functional init/apply with explicit BN state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batchnorm import sync_batch_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    block_sizes: Sequence[int] = (3, 4, 6, 3)  # ResNet-50
+    width: int = 64
+    num_classes: int = 1000
+    bn_axis_name: Optional[str] = None  # "data" => SyncBN over the DP axis
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-5
+
+
+def resnet50_config(**kw) -> ResNetConfig:
+    return ResNetConfig(block_sizes=(3, 4, 6, 3), **kw)
+
+
+def resnet18_config(**kw) -> ResNetConfig:
+    # basic-block resnets use the bottleneck path with expansion 1
+    return ResNetConfig(block_sizes=(2, 2, 2, 2), **kw)
+
+
+def _conv_init(key, shape):
+    # he-normal fan_out (torchvision default for resnets)
+    fan_out = shape[0] * shape[1] * shape[3]
+    std = jnp.sqrt(2.0 / fan_out)
+    return jax.random.normal(key, shape) * std
+
+
+def _conv(x, w, stride=1, padding="SAME"):
+    # no preferred_element_type: the MXU accumulates bf16 convs in fp32
+    # anyway, and a widened output dtype breaks the conv transpose rule
+    # (fp32 cotangent vs bf16 weights) under jax.grad
+    return jax.lax.conv_general_dilated(
+        x, w, (stride, stride), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+class ResNet:
+    """Functional ResNet with bottleneck blocks (v1.5: stride on the 3x3)."""
+
+    expansion = 4
+
+    def __init__(self, cfg: ResNetConfig):
+        self.cfg = cfg
+
+    # -- init ----------------------------------------------------------------
+
+    def _bn_init(self, c):
+        return ({"weight": jnp.ones((c,)), "bias": jnp.zeros((c,))},
+                {"mean": jnp.zeros((c,), jnp.float32),
+                 "var": jnp.ones((c,), jnp.float32)})
+
+    def init(self, key, dtype=jnp.float32) -> Tuple[Dict, Dict]:
+        """Returns (params, bn_state)."""
+        cfg = self.cfg
+        params: Dict[str, Any] = {}
+        state: Dict[str, Any] = {}
+        key, k = jax.random.split(key)
+        params["conv1"] = {"w": _conv_init(k, (7, 7, 3, cfg.width)).astype(dtype)}
+        params["bn1"], state["bn1"] = self._bn_init(cfg.width)
+
+        in_c = cfg.width
+        for stage, n_blocks in enumerate(cfg.block_sizes):
+            mid = cfg.width * (2 ** stage)
+            out_c = mid * self.expansion
+            stride = 1 if stage == 0 else 2
+            blocks = []
+            bstates = []
+            for b in range(n_blocks):
+                key, k1, k2, k3, k4 = jax.random.split(key, 5)
+                blk: Dict[str, Any] = {
+                    "conv1": {"w": _conv_init(k1, (1, 1, in_c, mid)).astype(dtype)},
+                    "conv2": {"w": _conv_init(k2, (3, 3, mid, mid)).astype(dtype)},
+                    "conv3": {"w": _conv_init(k3, (1, 1, mid, out_c)).astype(dtype)},
+                }
+                bst: Dict[str, Any] = {}
+                blk["bn1"], bst["bn1"] = self._bn_init(mid)
+                blk["bn2"], bst["bn2"] = self._bn_init(mid)
+                blk["bn3"], bst["bn3"] = self._bn_init(out_c)
+                # zero-init the last BN gamma (torchvision zero_init_residual
+                # improves early training; harmless otherwise)
+                if b == 0 and (stride != 1 or in_c != out_c):
+                    blk["downsample"] = {
+                        "w": _conv_init(k4, (1, 1, in_c, out_c)).astype(dtype)}
+                    blk["bn_ds"], bst["bn_ds"] = self._bn_init(out_c)
+                blocks.append(blk)
+                bstates.append(bst)
+                in_c = out_c
+            params[f"layer{stage + 1}"] = blocks
+            state[f"layer{stage + 1}"] = bstates
+
+        key, k = jax.random.split(key)
+        params["fc"] = {
+            "w": (jax.random.normal(k, (in_c, cfg.num_classes)) / jnp.sqrt(in_c)
+                  ).astype(dtype),
+            "b": jnp.zeros((cfg.num_classes,), dtype),
+        }
+        return params, state
+
+    # -- apply ---------------------------------------------------------------
+
+    def _bn(self, p, s, x, training):
+        cfg = self.cfg
+        y, rm, rv = sync_batch_norm(
+            x, p["weight"], p["bias"], s["mean"], s["var"],
+            axis_name=cfg.bn_axis_name if training else None,
+            training=training, momentum=cfg.bn_momentum, eps=cfg.bn_eps,
+            channel_axis=-1)
+        new_s = {"mean": rm, "var": rv} if rm is not None else s
+        return y, new_s
+
+    def _block(self, p, s, x, stride, training):
+        new_s = {}
+        h, new_s["bn1"] = self._bn(p["bn1"], s["bn1"],
+                                   _conv(x, p["conv1"]["w"]), training)
+        h = jax.nn.relu(h)
+        h, new_s["bn2"] = self._bn(p["bn2"], s["bn2"],
+                                   _conv(h, p["conv2"]["w"], stride), training)
+        h = jax.nn.relu(h)
+        h, new_s["bn3"] = self._bn(p["bn3"], s["bn3"],
+                                   _conv(h, p["conv3"]["w"]), training)
+        if "downsample" in p:
+            sc, new_s["bn_ds"] = self._bn(
+                p["bn_ds"], s["bn_ds"],
+                _conv(x, p["downsample"]["w"], stride), training)
+        else:
+            sc = x
+        return jax.nn.relu(h + sc), new_s
+
+    def apply(self, params, state, x, *, training: bool = True):
+        """x: [N, H, W, 3] NHWC.  Returns (logits, new_bn_state)."""
+        new_state: Dict[str, Any] = {}
+        h = _conv(x, params["conv1"]["w"], stride=2)
+        h, new_state["bn1"] = self._bn(params["bn1"], state["bn1"], h, training)
+        h = jax.nn.relu(h)
+        h = jax.lax.reduce_window(
+            h, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+            [(0, 0), (1, 1), (1, 1), (0, 0)])
+
+        for stage in range(len(self.cfg.block_sizes)):
+            blocks = params[f"layer{stage + 1}"]
+            bstates = state[f"layer{stage + 1}"]
+            new_bstates = []
+            for b, (bp, bs) in enumerate(zip(blocks, bstates)):
+                stride = (1 if stage == 0 else 2) if b == 0 else 1
+                h, ns = self._block(bp, bs, h, stride, training)
+                new_bstates.append(ns)
+            new_state[f"layer{stage + 1}"] = new_bstates
+
+        h = jnp.mean(h, axis=(1, 2))  # global average pool
+        logits = (h.astype(jnp.float32) @ params["fc"]["w"].astype(jnp.float32)
+                  + params["fc"]["b"].astype(jnp.float32))
+        return logits, new_state
+
+    __call__ = apply

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Benchmark driver — ResNet-50 images/sec on one TPU chip.
+
+Mirrors BASELINE.md config #1: ResNet-50, amp O2 (bf16 compute, fp32 master
+weights, dynamic loss scale), FusedLAMB, synthetic ImageNet batch — the
+throughput the reference's examples/imagenet/main_amp.py prints per
+iteration (:361-376).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is relative to the recorded first-round number in
+BASELINE.json (falls back to 1.0 when absent — the reference publishes no
+numeric tables, SURVEY.md §6).
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import amp, optimizers
+from apex_tpu.models import ResNet, resnet50_config
+from apex_tpu.ops import softmax_cross_entropy_loss
+
+BATCH = int(os.environ.get("BENCH_BATCH", "64"))
+IMG = 224
+STEPS = int(os.environ.get("BENCH_STEPS", "20"))
+
+
+def main():
+    model = ResNet(resnet50_config())
+    params, bn_state = model.init(jax.random.PRNGKey(0))
+
+    amp_state = amp.initialize("O2")  # bf16 compute, fp32 master, dyn scale
+    compute_params = amp_state.cast_model(params)
+    scaler = amp_state.scaler
+    scale_state = scaler.init()
+
+    opt = optimizers.FusedLAMB(lr=1e-3, weight_decay=1e-4)
+    opt_state = opt.init(params)
+
+    def loss_fn(p, bn, x, y):
+        logits, new_bn = model.apply(p, bn, x, training=True)
+        return softmax_cross_entropy_loss(logits, y).mean(), new_bn
+
+    grad_fn = amp.scaled_value_and_grad(loss_fn, scaler, has_aux=True)
+
+    @jax.jit
+    def train_step(params, bn, opt_state, scale_state, x, y):
+        half = amp_state.cast_model(params)
+        (loss, new_bn), grads, finite = grad_fn(scale_state, half, bn, x, y)
+        new_params, new_opt = opt.step(grads, opt_state, params)
+        params, opt_state = amp.skip_or_step(
+            finite, (new_params, new_opt), (params, opt_state))
+        scale_state = scaler.update(scale_state, finite)
+        return params, new_bn, opt_state, scale_state, loss
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (BATCH, IMG, IMG, 3),
+                          jnp.bfloat16)
+    y = jax.random.randint(jax.random.PRNGKey(2), (BATCH,), 0, 1000)
+
+    # warmup / compile (float() fetches the value — a hard sync even on
+    # platforms whose block_until_ready returns before execution finishes)
+    params, bn_state, opt_state, scale_state, loss = train_step(
+        params, bn_state, opt_state, scale_state, x, y)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        params, bn_state, opt_state, scale_state, loss = train_step(
+            params, bn_state, opt_state, scale_state, x, y)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+    assert jnp.isfinite(final_loss), f"training diverged: {final_loss}"
+
+    ips = BATCH * STEPS / dt
+    baseline = None
+    try:
+        with open(os.path.join(os.path.dirname(__file__), "BASELINE.json")) as f:
+            baseline = json.load(f).get("measured", {}).get(
+                "resnet50_images_per_sec")
+    except Exception:
+        pass
+    print(json.dumps({
+        "metric": "resnet50_amp_o2_fusedlamb_images_per_sec",
+        "value": round(ips, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ips / baseline, 3) if baseline else 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()
