@@ -1,0 +1,185 @@
+"""ZeRO-style sharded optimizers over the mesh "data" axis.
+
+TPU-native re-design of the reference's sharded distributed optimizers:
+
+* ``DistributedFusedAdam`` v1-v3
+  (reference apex/contrib/optimizers/distributed_fused_adam.py:9-636),
+* ``DistributedFusedLAMB``
+  (reference apex/contrib/optimizers/distributed_fused_lamb.py:10-975).
+
+Reference architecture: the flat fp16 grad buffer is split into
+blocks→chunks→shards (distributed_fused_lamb.py:364-434); per-block
+reduce-scatters overlap with backward via grad hooks (:316-362); each rank
+runs the optimizer on its shard; updated param shards are all-gathered
+(optionally e5m2-compressed).
+
+TPU mapping — the communication pattern survives, the machinery dissolves:
+
+* flat buffer        → one packed superblock (:mod:`apex_tpu.multi_tensor.flat`),
+  padded so its length divides the shard count;
+* chunked reduce-scatter + hooks → a single ``lax.psum_scatter`` inside the
+  jitted step (XLA's scheduler overlaps it with the backward);
+* sharded Adam/LAMB step → the fused update on this rank's shard slice;
+* allgather of updated shards → ``lax.all_gather(tiled=True)``, optionally
+  through an e5m2 cast (same 8-bit-exponent format as the reference's
+  compressed allgather);
+* LAMB's global grad-norm prepass (fused_lamb.py:121-136) → shard-local
+  square-sum + one extra psum term fused into the same step.
+
+Must run inside a region binding ``axis_name`` (shard_map over the mesh).
+Optimizer state lives ONLY for this rank's shard — memory per device is
+``params + 2·params/N`` instead of ``3·params`` (the ZeRO claim).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor.flat import FlatSchema, flatten, make_schema, unflatten
+
+
+class ShardedOptState(NamedTuple):
+    step: jnp.ndarray  # i32 scalar
+    exp_avg: jnp.ndarray  # [shard] f32 (momentum)
+    exp_avg_sq: jnp.ndarray  # [shard] f32 (2nd moment)
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedShardedOptimizer:
+    """Common psum_scatter → sharded-update → all_gather engine."""
+
+    lr: float = 1e-3
+    betas: Tuple[float, float] = (0.9, 0.999)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    bias_correction: bool = True
+    axis_name: str = "data"
+    grad_average: bool = True
+    e5m2_allgather: bool = False  # reference distributed_fused_lamb.py:93
+
+    # -- host-side setup -----------------------------------------------------
+
+    def make_schema(self, params, n_shards: int) -> FlatSchema:
+        """Pack layout whose total length divides ``n_shards``
+        (the block/chunk/shard alignment of the reference, :364-434)."""
+        return make_schema(params, align=128,
+                           total_multiple_of=128 * n_shards)
+
+    def init(self, params, schema: FlatSchema, n_shards: int) -> ShardedOptState:
+        """Per-rank shard state (call inside shard_map, or once per rank)."""
+        shard = schema.total // n_shards
+        return ShardedOptState(
+            step=jnp.zeros((), jnp.int32),
+            exp_avg=jnp.zeros((shard,), jnp.float32),
+            exp_avg_sq=jnp.zeros((shard,), jnp.float32),
+        )
+
+    # -- the sharded step ----------------------------------------------------
+
+    def _shard_update(self, p, g, state, lr):
+        raise NotImplementedError
+
+    def step(self, grads, state: ShardedOptState, params,
+             schema: FlatSchema):
+        """One ZeRO step; call inside shard_map binding ``axis_name``.
+
+        Returns ``(new_params, new_state)`` with new_params identical
+        (bitwise) on every rank of the axis.
+        """
+        world = jax.lax.psum(1, self.axis_name)
+        rank = jax.lax.axis_index(self.axis_name)
+        shard = schema.total // world
+
+        flat_g, _ = flatten(grads, schema, dtype=jnp.float32)
+        # reduce-scatter: each rank receives the summed shard it owns
+        g_shard = jax.lax.psum_scatter(flat_g, self.axis_name, tiled=True)
+        if self.grad_average:
+            g_shard = g_shard / world
+
+        flat_p, _ = flatten(params, schema, dtype=jnp.float32)
+        p_shard = jax.lax.dynamic_slice_in_dim(flat_p, rank * shard, shard)
+
+        new_p_shard, new_state = self._shard_update(
+            p_shard, g_shard, state, flat_g)
+
+        if self.e5m2_allgather:
+            # 8-bit-exponent compressed transport (reference e5m2_allgather):
+            # ship the *delta* in e5m2 so the fp32 base is preserved
+            delta = (new_p_shard - p_shard).astype(jnp.float8_e5m2)
+            gathered = jax.lax.all_gather(delta, self.axis_name, axis=0,
+                                          tiled=True).astype(jnp.float32)
+            new_flat_p = flat_p + gathered
+        else:
+            new_flat_p = jax.lax.all_gather(new_p_shard, self.axis_name,
+                                            axis=0, tiled=True)
+        return unflatten(new_flat_p, schema), new_state
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedFusedAdam(DistributedShardedOptimizer):
+    """Sharded AdamW (reference distributed_fused_adam.py:9; the update math
+    is multi_tensor_distopt_adam_kernel.cu's)."""
+
+    adam_w_mode: bool = True
+
+    def _shard_update(self, p, g, state, flat_g):
+        del flat_g
+        b1, b2 = self.betas
+        step = state.step + 1
+        m = b1 * state.exp_avg + (1 - b1) * g
+        v = b2 * state.exp_avg_sq + (1 - b2) * g * g
+        if self.bias_correction:
+            c1 = 1 - b1 ** step.astype(jnp.float32)
+            c2 = 1 - b2 ** step.astype(jnp.float32)
+        else:
+            c1 = c2 = 1.0
+        update = (m / c1) / (jnp.sqrt(v / c2) + self.eps)
+        if self.adam_w_mode:
+            update = update + self.weight_decay * p
+        new_p = p - self.lr * update
+        return new_p, ShardedOptState(step, m, v)
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedFusedLAMB(DistributedShardedOptimizer):
+    """Sharded LAMB (reference distributed_fused_lamb.py:10): global grad
+    norm for clipping, per-shard trust ratio over the shard's param/update
+    norms.
+
+    Divergence note: the reference computes the trust ratio per *tensor*
+    (multi_tensor_lamb_compute_update_term); sharded layout makes per-shard
+    the natural granularity here.  Per-tensor trust ratios remain available
+    via the unsharded :class:`apex_tpu.optimizers.FusedLAMB`.
+    """
+
+    max_grad_norm: float = 1.0
+    weight_decay: float = 0.01
+
+    def _shard_update(self, p, g, state, flat_g):
+        b1, b2 = self.betas
+        step = state.step + 1
+        # global grad norm: shard-local square-sum, psum'd (the reference's
+        # fused L2-norm prepass + allreduce, distributed_fused_lamb.py:592)
+        local_sq = jnp.sum(g * g)
+        global_norm = jnp.sqrt(jax.lax.psum(local_sq, self.axis_name))
+        if self.max_grad_norm > 0:
+            clip = jnp.maximum(1.0, global_norm / self.max_grad_norm)
+            g = g / clip
+        m = b1 * state.exp_avg + (1 - b1) * g
+        v = b2 * state.exp_avg_sq + (1 - b2) * g * g
+        if self.bias_correction:
+            c1 = 1 - b1 ** step.astype(jnp.float32)
+            c2 = 1 - b2 ** step.astype(jnp.float32)
+        else:
+            c1 = c2 = 1.0
+        update = (m / c1) / (jnp.sqrt(v / c2) + self.eps)
+        update = update + self.weight_decay * p
+        p_norm = jnp.linalg.norm(p)
+        u_norm = jnp.linalg.norm(update)
+        trust = jnp.where((p_norm > 0) & (u_norm > 0), p_norm / u_norm, 1.0)
+        new_p = p - self.lr * trust * update
+        return new_p, ShardedOptState(step, m, v)

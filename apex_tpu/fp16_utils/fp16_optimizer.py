@@ -1,0 +1,116 @@
+"""Legacy FP16_Optimizer wrapper.
+
+TPU-native re-design of ``apex.fp16_utils.FP16_Optimizer``
+(reference fp16_utils/fp16_optimizer.py:13, 554 LoC) and the contrib
+variants (apex/contrib/optimizers/fp16_optimizer.py:4).
+
+The reference predates amp: it wraps a torch optimizer, keeps fp32 master
+params, scales the loss in ``backward(loss)``, checks overflow, and steps
+or skips.  Functionally that is exactly the amp O2 pipeline, so this class
+is a thin stateful convenience facade over the pure pieces
+(:mod:`apex_tpu.amp`) for users porting legacy reference code; new code
+should use ``amp.initialize`` + ``scaled_value_and_grad`` directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler
+from apex_tpu.utils.tree import tree_cast, tree_select
+
+
+class FP16_Optimizer:
+    """Stateful wrapper: holds fp32 master params + loss-scale state.
+
+    Usage (mirroring reference fp16_optimizer.py docs)::
+
+        opt = FP16_Optimizer(FusedAdam(lr), static_loss_scale=None)
+        opt.load_params(model_params)            # fp32 masters
+        loss, half_params = ..., opt.model_params()  # bf16 compute copy
+        grads, finite = opt.backward(loss_fn, half_params, batch)
+        opt.step(grads, finite)
+    """
+
+    def __init__(self, init_optimizer, static_loss_scale: Optional[float] = None,
+                 dynamic_loss_scale: bool = True, dynamic_loss_args: dict = None,
+                 verbose: bool = False, half_dtype=jnp.bfloat16):
+        self.optimizer = init_optimizer
+        if static_loss_scale is not None:
+            self.loss_scaler = LossScaler.static(static_loss_scale)
+        elif dynamic_loss_scale:
+            self.loss_scaler = LossScaler.dynamic_scaler(
+                **(dynamic_loss_args or {}))
+        else:
+            self.loss_scaler = LossScaler.static(1.0)
+        self.scale_state = self.loss_scaler.init()
+        self.half_dtype = half_dtype
+        self.verbose = verbose
+        self.master_params = None
+        self.opt_state = None
+
+    # -- param management ----------------------------------------------------
+
+    def load_params(self, params):
+        """fp32 master copy (reference keeps fp32 flat masters per group)."""
+        self.master_params = tree_cast(params, jnp.float32)
+        self.opt_state = self.optimizer.init(self.master_params)
+
+    def model_params(self):
+        """Half compute copy (reference master_params_to_model_params)."""
+        return tree_cast(self.master_params, self.half_dtype)
+
+    # -- training protocol ---------------------------------------------------
+
+    def backward(self, loss_fn: Callable, *args):
+        """Scaled backward (reference ``backward(loss)``): returns
+        ``(grads_fp32_unscaled, finite)``; also stores loss for logging."""
+        def scaled(*a):
+            loss = loss_fn(*a)
+            return self.loss_scaler.scale(loss, self.scale_state), loss
+
+        (_, self.last_loss), grads = jax.value_and_grad(
+            scaled, has_aux=True)(*args)
+        grads, finite = self.loss_scaler.unscale(grads, self.scale_state)
+        return grads, finite
+
+    def clip_master_grads(self, grads, max_norm: float, norm_type: int = 2):
+        """Reference ``clip_master_grads`` (fp16_optimizer.py:297)."""
+        leaves = jax.tree_util.tree_leaves(grads)
+        total = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+        clip = jnp.maximum(1.0, total / max_norm)
+        return jax.tree_util.tree_map(lambda g: g / clip, grads), total
+
+    def step(self, grads, finite):
+        """Apply or skip (reference step-with-overflow-check)."""
+        new_params, new_opt = self.optimizer.step(
+            grads, self.opt_state, self.master_params)
+        self.master_params = tree_select(finite, new_params, self.master_params)
+        self.opt_state = tree_select(finite, new_opt, self.opt_state)
+        self.scale_state = self.loss_scaler.update(self.scale_state, finite)
+
+    # -- checkpointing (reference fp16_optimizer.py:209-271) ------------------
+
+    def state_dict(self):
+        return {
+            "loss_scale": self.scale_state.loss_scale,
+            "unskipped": self.scale_state.unskipped,
+            "master_params": self.master_params,
+            "opt_state": self.opt_state,
+        }
+
+    def load_state_dict(self, sd):
+        from apex_tpu.amp.scaler import LossScaleState
+
+        self.scale_state = LossScaleState(
+            loss_scale=jnp.asarray(sd["loss_scale"], jnp.float32),
+            unskipped=jnp.asarray(sd["unskipped"], jnp.int32))
+        self.master_params = sd["master_params"]
+        self.opt_state = sd["opt_state"]
+
+    @property
+    def loss_scale(self):
+        return self.scale_state.loss_scale

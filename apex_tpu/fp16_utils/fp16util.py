@@ -1,0 +1,70 @@
+"""Precision conversion utilities.
+
+TPU-native port of ``apex.fp16_utils.fp16util`` (reference fp16util.py:7-187):
+network/tensor half conversion with keep-BN-fp32, and master↔model param
+synchronisation — as pure pytree transforms.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.properties import _is_bn_path
+from apex_tpu.utils.tree import tree_cast
+
+
+def tofp16(tree):
+    """Reference ``tofp16`` (:7) — on TPU the half type is bf16 by default;
+    use :func:`convert_network` for dtype choice."""
+    return tree_cast(tree, jnp.bfloat16)
+
+
+def BN_convert_float(tree):
+    """Cast BN-named leaves to fp32 (reference :22-31)."""
+    def _cast(path, x):
+        if hasattr(x, "dtype") and _is_bn_path(path):
+            return x.astype(jnp.float32)
+        return x
+
+    return jax.tree_util.tree_map_with_path(_cast, tree)
+
+
+def network_to_half(tree):
+    """Half everything except BN (reference :34-55)."""
+    return BN_convert_float(tofp16(tree))
+
+
+def convert_network(tree, dtype):
+    """Reference :58-77."""
+    def _cast(path, x):
+        if not hasattr(x, "dtype") or not jnp.issubdtype(x.dtype, jnp.floating):
+            return x
+        if _is_bn_path(path):
+            return x.astype(jnp.float32)
+        return x.astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(_cast, tree)
+
+
+def prep_param_lists(params):
+    """Reference :80-120 returns (model_params, master_params); functional
+    equivalent returns the fp32 master copy."""
+    return params, tree_cast(params, jnp.float32)
+
+
+def master_params_to_model_params(model_params, master_params):
+    """Copy master values into the model-dtype tree (reference :123-140)."""
+    return jax.tree_util.tree_map(
+        lambda model, master: master.astype(model.dtype),
+        model_params, master_params)
+
+
+def model_grads_to_master_grads(model_grads):
+    """Reference :143-160: fp32 copies of half grads."""
+    return tree_cast(model_grads, jnp.float32)
+
+
+def to_python_float(t):
+    """Reference :180-187."""
+    return float(jnp.asarray(t).reshape(()))

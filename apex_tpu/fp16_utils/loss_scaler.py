@@ -1,0 +1,24 @@
+"""Legacy loss scalers (reference apex/fp16_utils/loss_scaler.py:10-186).
+
+Thin aliases over the modern pure scaler (:mod:`apex_tpu.amp.scaler`) with
+the legacy class names and defaults, for code ported from the reference's
+pre-amp API.
+"""
+
+from __future__ import annotations
+
+from apex_tpu.amp.scaler import LossScaler as _ModernScaler
+from apex_tpu.amp.scaler import LossScaleState  # noqa: F401
+
+
+def LossScaler(scale: float = 1.0) -> _ModernScaler:
+    """Static scaler (reference loss_scaler.py:10-44)."""
+    return _ModernScaler.static(scale)
+
+
+def DynamicLossScaler(init_scale: float = 2.0 ** 32, scale_factor: float = 2.0,
+                      scale_window: int = 1000) -> _ModernScaler:
+    """Dynamic scaler with the legacy defaults (reference loss_scaler.py:47:
+    init 2^32, window 1000)."""
+    return _ModernScaler(init_scale=init_scale, scale_factor=scale_factor,
+                         scale_window=scale_window, dynamic=True)
