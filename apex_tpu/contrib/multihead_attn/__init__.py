@@ -1,0 +1,7 @@
+"""apex_tpu.contrib.multihead_attn — fused MHA modules
+(reference apex/contrib/multihead_attn/, 8 CUDA extensions)."""
+
+from apex_tpu.contrib.multihead_attn.attn import (  # noqa: F401
+    EncdecMultiheadAttn,
+    SelfMultiheadAttn,
+)

@@ -1,0 +1,334 @@
+"""Fused (flash) attention and ring attention.
+
+TPU-native replacement for the reference's two fused-attention stacks:
+
+* **FMHA** (reference apex/contrib/fmha/fmha.py:33-75, kernels
+  apex/contrib/csrc/fmha/ ~5,900 LoC sm80 CUDA): fp16, seqlen ∈
+  {128,256,384,512}, head dim 64, BERT-style varlen packing.
+* **fast multihead attn** (reference apex/contrib/multihead_attn/, 8 CUDA
+  extensions): self/encdec × {plain, bias, norm-add, additive-mask}
+  variants that fuse mask+softmax+dropout and remove transposes.
+
+Here ONE Pallas flash-attention kernel covers every case — any sequence
+length (no 512 cap), any head dim, bf16/fp32, causal or padding or additive
+masks — with online-softmax accumulation so the S×S score matrix never
+materialises in HBM.  The backward recomputes blockwise (flash-attention-2
+style) as a scanned XLA computation: memory stays O(S·D) and XLA fuses the
+per-block matmuls onto the MXU.
+
+Long-context / sequence parallelism (SURVEY.md §5.7 — absent in the
+2021 reference, first-class here): :func:`ring_attention` shards the
+sequence axis across a mesh axis and rotates K/V blocks with
+``lax.ppermute``, combining per-block partial softmax statistics exactly
+like the in-chip flash kernel does — attention over sequences far beyond
+one chip's HBM, with compute/ICI overlap handled by XLA.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from apex_tpu.ops._pallas import use_interpret
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                      scale, causal, block_k, sk, sq_total, q_block_start):
+    # q_ref: [block_q, d]; k_ref/v_ref: [sk, d]
+    block_q, d = q_ref.shape
+    q = q_ref[...]  # stay in input dtype: bf16 feeds the MXU at full rate
+    qi = q_block_start  # absolute row offset of this q block
+
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    n_kb = sk // block_k
+    if causal:
+        # dynamic trip count: skip k blocks strictly above this q block's
+        # last row (fully masked) — halves the work like the reference's
+        # upper-triang kernel.  fori_loop lowers a traced bound to a
+        # while loop.
+        last_row = qi + block_q - 1 + (sk - sq_total)
+        n_kb = jnp.minimum(n_kb, last_row // block_k + 1)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[pl.ds(kb * block_k, block_k), :]
+        v = v_ref[pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            # only the diagonal-straddling block needs element masking;
+            # interior blocks are fully visible (cond saves the VPU work)
+            def masked(s):
+                rows = qi + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                cols = kb * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 1)
+                return jnp.where(rows + (sk - sq_total) >= cols, s, _NEG_INF)
+
+            fully_visible = (kb * block_k + block_k - 1) <= (
+                qi + (sk - sq_total))
+            s = jax.lax.cond(fully_visible, lambda s: s, masked, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_new = acc * alpha[:, None] + pv
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0, 1.0, l)
+    o_ref[...] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[...] = (m + jnp.log(l_safe))[:, None]
+
+
+def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k):
+    """q [bh, sq, d], k/v [bh, sk, d] → (o [bh, sq, d], lse [bh, sq])."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    n_qb = sq // block_q
+
+    outs = []
+    grid = (bh, n_qb)
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref):
+        qb = pl.program_id(1)
+        _flash_fwd_kernel(
+            q_ref.at[0], k_ref.at[0], v_ref.at[0], o_ref.at[0], lse_ref.at[0],
+            scale=scale, causal=causal, block_k=block_k, sk=sk,
+            sq_total=sq, q_block_start=qb * block_q)
+
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            # lse carries a trailing singleton lane dim to satisfy the TPU
+            # (sublane, lane) block tiling rules
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+        ],
+        interpret=use_interpret(),
+    )(q, k, v)
+    return o, lse[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# Blockwise reference math (XLA path + backward)
+# ---------------------------------------------------------------------------
+
+
+def _blockwise_fwd_xla(q, k, v, scale, causal, mask_bias):
+    """Plain-XLA online-softmax forward (used off-TPU and as the residual
+    recompute definition).  mask_bias: additive [bh?, sq, sk] or None."""
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if mask_bias is not None:
+        s = s + mask_bias
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        tri = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(tri, s, _NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32))
+    o = o / jnp.where(l == 0, 1.0, l)[..., None]
+    lse = m + jnp.log(jnp.where(l == 0, 1.0, l))
+    return o.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_attention(q, k, v, mask_bias, scale, causal, block_q, block_k):
+    use_pallas = (jax.default_backend() == "tpu" and mask_bias is None
+                  and q.shape[1] % min(block_q, q.shape[1]) == 0
+                  and k.shape[1] % min(block_k, k.shape[1]) == 0)
+    if use_pallas:
+        o, _ = _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k)
+        return o
+    o, _ = _blockwise_fwd_xla(q, k, v, scale, causal, mask_bias)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, mask_bias, scale, causal, block_q, block_k):
+    use_pallas = (jax.default_backend() == "tpu" and mask_bias is None
+                  and q.shape[1] % min(block_q, q.shape[1]) == 0
+                  and k.shape[1] % min(block_k, k.shape[1]) == 0)
+    if use_pallas:
+        o, lse = _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k)
+    else:
+        o, lse = _blockwise_fwd_xla(q, k, v, scale, causal, mask_bias)
+    return o, (q, k, v, mask_bias, o, lse)
+
+
+def _flash_bwd_rule(scale, causal, block_q, block_k, res, do):
+    """Flash-attention-2 backward: blockwise over k-blocks with a lax.scan
+    so the S×S matrix never materialises; delta = rowsum(dO·O)."""
+    q, k, v, mask_bias, o, lse = res
+    q32, k32, v32 = (t.astype(jnp.float32) for t in (q, k, v))
+    do32 = do.astype(jnp.float32)
+    delta = jnp.sum(do32 * o.astype(jnp.float32), axis=-1)  # [bh, sq]
+    sq, sk = q.shape[1], k.shape[1]
+    bk = min(block_k, sk)
+    n_kb = sk // bk if sk % bk == 0 else 1
+    if sk % bk != 0:
+        bk = sk
+
+    def kblock(carry, kb):
+        dq_acc = carry
+        ks = jax.lax.dynamic_slice_in_dim(k32, kb * bk, bk, axis=1)
+        vs = jax.lax.dynamic_slice_in_dim(v32, kb * bk, bk, axis=1)
+        s = jnp.einsum("bqd,bkd->bqk", q32, ks) * scale
+        if mask_bias is not None:
+            mb = jax.lax.dynamic_slice_in_dim(mask_bias, kb * bk, bk, axis=-1)
+            s = s + mb
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (sq, bk), 0)
+            cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (sq, bk), 1)
+            s = jnp.where((rows + (sk - sq))[None] >= cols[None], s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])  # exact probabilities
+        dv = jnp.einsum("bqk,bqd->bkd", p, do32)
+        dp = jnp.einsum("bqd,bkd->bqk", do32, vs)
+        ds = p * (dp - delta[..., None]) * scale
+        dk = jnp.einsum("bqk,bqd->bkd", ds, q32)
+        dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds, ks)
+        return dq_acc, (dk, dv)
+
+    dq0 = jnp.zeros_like(q32)
+    dq, (dks, dvs) = jax.lax.scan(kblock, dq0, jnp.arange(n_kb))
+    dk = jnp.moveaxis(dks, 0, 1).reshape(k.shape[0], sk, k.shape[2])
+    dv = jnp.moveaxis(dvs, 0, 1).reshape(v.shape[0], sk, v.shape[2])
+    dmask = None
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), dmask)
+
+
+_flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    mask_bias: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+    block_q: int = 256,
+    block_k: int = 256,
+) -> jnp.ndarray:
+    """Fused attention over [b, h, s, d] (or [bh, s, d]) tensors.
+
+    Drop-in for the reference's ``fmha.FMHAFun`` (fmha.py:33) and the core
+    of every ``fast_*_multihead_attn`` — without its seq-len/head-dim
+    restrictions.  ``mask_bias`` is an *additive* mask (the
+    additive-mask-softmax variants); boolean masks should be converted with
+    ``jnp.where(mask, -10000.0, 0.0)``.
+    """
+    squeeze = False
+    if q.ndim == 4:
+        b, h, sq, d = q.shape
+        q = q.reshape(b * h, sq, d)
+        k = k.reshape(b * h, k.shape[2], d)
+        v = v.reshape(b * h, v.shape[2], d)
+        if mask_bias is not None and mask_bias.ndim == 4:
+            mb, hh = mask_bias.shape[:2]
+            mask_bias = jnp.broadcast_to(
+                mask_bias, (b, h, sq, k.shape[1])).reshape(b * h, sq, k.shape[1])
+        squeeze = (b, h)
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    o = _flash_attention(q, k, v, mask_bias, float(scale), bool(causal),
+                         int(block_q), int(block_k))
+    if squeeze:
+        b, h = squeeze
+        o = o.reshape(b, h, o.shape[1], o.shape[2])
+    return o
+
+
+# ---------------------------------------------------------------------------
+# Ring attention — sequence/context parallelism over a mesh axis
+# ---------------------------------------------------------------------------
+
+
+def ring_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Attention with the sequence axis sharded over ``axis_name``.
+
+    Each device holds its local q/k/v chunk [bh, s_local, d]; K/V chunks
+    rotate around the ring with ``lax.ppermute`` while every device
+    accumulates its queries' attention over each arriving block with the
+    same online-softmax combination the flash kernel uses.  After
+    ``world`` steps every query has attended to the full sequence.
+
+    Causal masking uses *global* positions: device r's queries own rows
+    ``[r·s_local, (r+1)·s_local)``.
+
+    Must run inside a region binding ``axis_name``.
+    """
+    world = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    bh, s_local, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    q32 = q.astype(jnp.float32) * scale
+
+    q_start = rank * s_local
+    perm = [(i, (i + 1) % world) for i in range(world)]
+
+    def step(carry, i):
+        m, l, acc, kc, vc, src = carry
+        s = jnp.einsum("bqd,bkd->bqk", q32, kc.astype(jnp.float32))
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (s_local, s_local), 0)
+            cols = src * s_local + jax.lax.broadcasted_iota(
+                jnp.int32, (s_local, s_local), 1)
+            s = jnp.where((rows >= cols)[None], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l = alpha * l + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bqk,bkd->bqd", p, vc.astype(jnp.float32))
+        # rotate K/V to the next device; track the owner of the new chunk
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        src = jax.lax.rem(src - 1 + world, world)
+        return (m_new, l, acc, kc, vc, src), None
+
+    m0 = jnp.full((bh, s_local), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bh, s_local), jnp.float32)
+    acc0 = jnp.zeros((bh, s_local, d), jnp.float32)
+    (m, l, acc, _, _, _), _ = jax.lax.scan(
+        step, (m0, l0, acc0, k, v, rank), jnp.arange(world))
+    l_safe = jnp.where(l == 0, 1.0, l)
+    return (acc / l_safe[..., None]).astype(q.dtype)

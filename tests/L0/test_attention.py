@@ -1,0 +1,220 @@
+"""Fused attention tests: flash kernel vs naive reference, ring attention
+across the 8-device mesh, contrib MHA modules.
+
+Mirrors reference tests: contrib/test/fmha/test_fmha.py (fused vs py
+reference), multihead_attn tests, plus the new long-context tier.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.contrib.multihead_attn import EncdecMultiheadAttn, SelfMultiheadAttn
+from apex_tpu.ops.attention import flash_attention, ring_attention
+
+
+def _naive(q, k, v, causal=False, mask_bias=None, scale=None):
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+    if mask_bias is not None:
+        s = s + mask_bias
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        tri = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(tri, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", p, v)
+
+
+class TestFlashAttention:
+    def test_matches_naive(self):
+        q = jax.random.normal(jax.random.PRNGKey(0), (4, 64, 32))
+        k = jax.random.normal(jax.random.PRNGKey(1), (4, 64, 32))
+        v = jax.random.normal(jax.random.PRNGKey(2), (4, 64, 32))
+        np.testing.assert_allclose(
+            flash_attention(q, k, v), _naive(q, k, v), rtol=1e-4, atol=1e-5)
+
+    def test_causal_matches_naive(self):
+        q = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 16))
+        k = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 16))
+        v = jax.random.normal(jax.random.PRNGKey(2), (2, 32, 16))
+        np.testing.assert_allclose(
+            flash_attention(q, k, v, causal=True), _naive(q, k, v, True),
+            rtol=1e-4, atol=1e-5)
+
+    def test_4d_and_cross_lengths(self):
+        q = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 16, 8))
+        k = jax.random.normal(jax.random.PRNGKey(1), (2, 4, 32, 8))
+        v = jax.random.normal(jax.random.PRNGKey(2), (2, 4, 32, 8))
+        np.testing.assert_allclose(
+            flash_attention(q, k, v), _naive(q, k, v), rtol=1e-4, atol=1e-5)
+
+    def test_additive_mask(self):
+        q = jax.random.normal(jax.random.PRNGKey(0), (3, 16, 8))
+        k = jax.random.normal(jax.random.PRNGKey(1), (3, 16, 8))
+        v = jax.random.normal(jax.random.PRNGKey(2), (3, 16, 8))
+        bias = jnp.where(
+            jax.random.bernoulli(jax.random.PRNGKey(3), 0.3, (3, 16, 16)),
+            -10000.0, 0.0)
+        np.testing.assert_allclose(
+            flash_attention(q, k, v, mask_bias=bias),
+            _naive(q, k, v, mask_bias=bias), rtol=1e-4, atol=1e-5)
+
+    def test_grads_match_naive(self):
+        q = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 16))
+        k = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 16))
+        v = jax.random.normal(jax.random.PRNGKey(2), (2, 32, 16))
+
+        def f_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+        def f_naive(q, k, v):
+            return jnp.sum(_naive(q, k, v, True) ** 2)
+
+        g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_naive, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-4)
+
+    def test_grads_with_blocked_bwd(self):
+        # force multi-block bwd (block_k < sk)
+        q = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 8))
+        k = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 8))
+        v = jax.random.normal(jax.random.PRNGKey(2), (2, 64, 8))
+
+        def f(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, block_q=16, block_k=16) ** 2)
+
+        def f_ref(q, k, v):
+            return jnp.sum(_naive(q, k, v) ** 2)
+
+        g1 = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-4)
+
+    def test_pallas_interpret_path_matches(self):
+        # exercise the Pallas kernel in interpret mode explicitly
+        from apex_tpu.ops.attention import _flash_fwd_pallas
+        q = jax.random.normal(jax.random.PRNGKey(0), (2, 256, 128))
+        k = jax.random.normal(jax.random.PRNGKey(1), (2, 256, 128))
+        v = jax.random.normal(jax.random.PRNGKey(2), (2, 256, 128))
+        o, lse = _flash_fwd_pallas(q, k, v, 1.0 / np.sqrt(128.0), True,
+                                   128, 128)
+        np.testing.assert_allclose(o, _naive(q, k, v, True), rtol=1e-4,
+                                   atol=1e-5)
+        assert lse.shape == (2, 256)
+
+
+class TestRingAttention:
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        return Mesh(np.array(jax.devices()[:8]), ("sp",))
+
+    def test_matches_full_attention(self, mesh):
+        # sequence 64 sharded 8 ways
+        q = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 16))
+        k = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 16))
+        v = jax.random.normal(jax.random.PRNGKey(2), (2, 64, 16))
+
+        def run(q, k, v):
+            return ring_attention(q, k, v, "sp")
+
+        out = shard_map(run, mesh=mesh,
+                        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+                        out_specs=P(None, "sp"), check_rep=False)(q, k, v)
+        np.testing.assert_allclose(out, _naive(q, k, v), rtol=1e-4, atol=1e-5)
+
+    def test_causal_matches_full(self, mesh):
+        q = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 16))
+        k = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 16))
+        v = jax.random.normal(jax.random.PRNGKey(2), (2, 64, 16))
+
+        def run(q, k, v):
+            return ring_attention(q, k, v, "sp", causal=True)
+
+        out = shard_map(run, mesh=mesh,
+                        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+                        out_specs=P(None, "sp"), check_rep=False)(q, k, v)
+        np.testing.assert_allclose(out, _naive(q, k, v, causal=True),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_grads_flow_through_ring(self, mesh):
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 32, 8))
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 32, 8))
+        v = jax.random.normal(jax.random.PRNGKey(2), (1, 32, 8))
+
+        def loss(q, k, v):
+            def run(q, k, v):
+                o = ring_attention(q, k, v, "sp", causal=True)
+                return jax.lax.psum(jnp.sum(o ** 2), "sp")
+            return shard_map(run, mesh=mesh,
+                             in_specs=(P(None, "sp"),) * 3,
+                             out_specs=P(), check_rep=False)(q, k, v)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(_naive(q, k, v, causal=True) ** 2)
+
+        g1 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-4)
+
+
+class TestMultiheadAttnModules:
+    def test_self_attn_matches_naive(self):
+        m = SelfMultiheadAttn(32, 4, bias=True)
+        p = m.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (10, 2, 32))
+        out = m.apply(p, x, is_training=False)
+        # reference: same projections + standard attention
+        qkv = x @ p["in_proj_weight"].T + p["in_proj_bias"]
+        q, k, v = jnp.split(qkv, 3, -1)
+
+        def heads(t):
+            return t.reshape(10, 2 * 4, 8).transpose(1, 0, 2)
+
+        ctx = _naive(heads(q), heads(k), heads(v), scale=8 ** -0.5)
+        ref = (ctx.transpose(1, 0, 2).reshape(10, 2, 32)
+               @ p["out_proj_weight"].T + p["out_proj_bias"])
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_self_attn_padding_mask(self):
+        m = SelfMultiheadAttn(16, 2)
+        p = m.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (6, 2, 16))
+        mask = jnp.array([[False] * 4 + [True] * 2,
+                          [False] * 6])
+        out = m.apply(p, x, key_padding_mask=mask, is_training=False)
+        assert out.shape == (6, 2, 16)
+        assert bool(jnp.all(jnp.isfinite(out)))
+
+    def test_norm_add_variant(self):
+        m = SelfMultiheadAttn(16, 2, include_norm_add=True)
+        p = m.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 2, 16))
+        out = m.apply(p, x, is_training=False)
+        # residual path present: zero attention weights would return x
+        assert out.shape == x.shape
+
+    def test_encdec(self):
+        m = EncdecMultiheadAttn(16, 2, bias=True)
+        p = m.init(jax.random.PRNGKey(0))
+        dec = jax.random.normal(jax.random.PRNGKey(1), (5, 2, 16))
+        enc = jax.random.normal(jax.random.PRNGKey(2), (9, 2, 16))
+        out = m.apply(p, dec, enc, is_training=False)
+        assert out.shape == (5, 2, 16)
+
+    def test_dropout_changes_output(self):
+        m = SelfMultiheadAttn(16, 2, dropout=0.5)
+        p = m.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 2, 16))
+        o1 = m.apply(p, x, is_training=True,
+                     dropout_rng=jax.random.PRNGKey(10))
+        o2 = m.apply(p, x, is_training=False)
+        assert not np.allclose(o1, o2)
