@@ -1,0 +1,9 @@
+"""apex_tpu.contrib.sparsity — ASP structured sparsity
+(reference apex/contrib/sparsity/)."""
+
+from apex_tpu.contrib.sparsity.asp import ASP  # noqa: F401
+from apex_tpu.contrib.sparsity.sparse_masklib import (  # noqa: F401
+    create_mask,
+    m4n2_1d,
+    unstructured_fraction,
+)

@@ -1,0 +1,81 @@
+"""ASP — automatic structured (2:4) sparsity.
+
+TPU-native re-design of ``apex.contrib.sparsity.ASP``
+(reference asp.py: ``init_model_for_pruning`` :139, mask re-application on
+every optimizer step :139-153, ``prune_trained_model`` :212).
+
+The reference monkey-patches ``optimizer.step`` to re-apply masks after
+every update.  Functionally, masks are just another pytree: compute them
+once from trained weights, then multiply into the params after each
+optimizer step (``apply_masks``) — the composition point the reference's
+patching simulates.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.contrib.sparsity.sparse_masklib import create_mask
+
+
+def _default_allowed(path, leaf) -> bool:
+    """Reference default: prune 2-D+ weights with both dims ≥ 16 and
+    divisible group dims (asp.py allowed_layer_names/whitelist logic)."""
+    if not hasattr(leaf, "ndim") or leaf.ndim < 2:
+        return False
+    if leaf.shape[-1] % 4 != 0:
+        return False
+    return leaf.shape[-1] >= 16 and int(jnp.prod(
+        jnp.array(leaf.shape[:-1]))) >= 16
+
+
+class ASP:
+    """Functional ASP. Typical use (mirrors reference asp.py:212
+    ``prune_trained_model(model, optimizer)``)::
+
+        asp = ASP(mask_pattern="m4n2_1d")
+        masks = asp.compute_sparse_masks(params)     # from trained weights
+        params = asp.apply_masks(params, masks)      # prune
+        ...
+        params = opt.step(...); params = asp.apply_masks(params, masks)
+    """
+
+    def __init__(self, mask_pattern: str = "m4n2_1d",
+                 allowed_predicate: Optional[Callable] = None,
+                 verbosity: int = 0):
+        self.mask_pattern = mask_pattern
+        self.allowed = allowed_predicate or _default_allowed
+        self.verbosity = verbosity
+
+    def compute_sparse_masks(self, params: Any) -> Any:
+        """Masks pytree: boolean per prunable leaf, ``None`` elsewhere
+        (reference compute_sparse_masks asp.py:139-160)."""
+        def mask_leaf(path, leaf):
+            if self.allowed(path, leaf):
+                return create_mask(leaf, self.mask_pattern)
+            return None
+
+        return jax.tree_util.tree_map_with_path(mask_leaf, params)
+
+    def apply_masks(self, params: Any, masks: Any) -> Any:
+        """Multiply masks in (the step the reference re-runs after every
+        optimizer update, asp.py:139-153)."""
+        return jax.tree_util.tree_map(
+            lambda p, m: p if m is None else p * m.astype(p.dtype),
+            params, masks, is_leaf=lambda x: x is None)
+
+    def prune_trained_model(self, params: Any) -> Any:
+        """One-shot prune (reference asp.py:212): compute + apply."""
+        masks = self.compute_sparse_masks(params)
+        return self.apply_masks(params, masks), masks
+
+    @staticmethod
+    def sparsity(params: Any) -> float:
+        leaves = [l for l in jax.tree_util.tree_leaves(params)
+                  if hasattr(l, "size")]
+        zeros = sum(float(jnp.sum(l == 0)) for l in leaves)
+        total = sum(l.size for l in leaves)
+        return zeros / max(total, 1)
