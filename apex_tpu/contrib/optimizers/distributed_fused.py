@@ -130,6 +130,10 @@ class DistributedFusedAdam(DistributedShardedOptimizer):
         del flat_g
         b1, b2 = self.betas
         step = state.step + 1
+        if not self.adam_w_mode:
+            # classic-Adam mode: L2-style decay folded into the gradient
+            # before the moment updates (reference non-AdamW branch)
+            g = g + self.weight_decay * p
         m = b1 * state.exp_avg + (1 - b1) * g
         v = b2 * state.exp_avg_sq + (1 - b2) * g * g
         if self.bias_correction:

@@ -102,6 +102,7 @@ class ResNet:
                 blk["bn3"], bst["bn3"] = self._bn_init(out_c)
                 # zero-init the last BN gamma (torchvision zero_init_residual
                 # improves early training; harmless otherwise)
+                blk["bn3"]["weight"] = jnp.zeros_like(blk["bn3"]["weight"])
                 if b == 0 and (stride != 1 or in_c != out_c):
                     blk["downsample"] = {
                         "w": _conv_init(k4, (1, 1, in_c, out_c)).astype(dtype)}

@@ -7,6 +7,10 @@ TPU-native replacements for the reference's fused CUDA op layer
 reference contract it mirrors.
 """
 
+from apex_tpu.ops.attention import (  # noqa: F401
+    flash_attention,
+    ring_attention,
+)
 from apex_tpu.ops.fused_dense import (  # noqa: F401
     FusedDense,
     FusedDenseGeluDense,

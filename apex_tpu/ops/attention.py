@@ -39,6 +39,12 @@ from apex_tpu.ops._pallas import use_interpret
 _NEG_INF = -1e30
 
 
+def _masked_exp(s, m):
+    """exp(s - m) with fully-masked rows (m still at _NEG_INF) forced to 0
+    so l stays 0 and the l_safe guard yields zeros instead of mean(V)."""
+    return jnp.where(m <= _NEG_INF / 2, 0.0, jnp.exp(s - m))
+
+
 # ---------------------------------------------------------------------------
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
@@ -83,7 +89,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                 qi + (sk - sq_total))
             s = jax.lax.cond(fully_visible, lambda s: s, masked, s)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
+        p = _masked_exp(s, m_new[:, None])
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p, axis=-1)
         pv = jax.lax.dot_general(
@@ -156,7 +162,7 @@ def _blockwise_fwd_xla(q, k, v, scale, causal, mask_bias):
         tri = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         s = jnp.where(tri, s, _NEG_INF)
     m = jnp.max(s, axis=-1)
-    p = jnp.exp(s - m[..., None])
+    p = _masked_exp(s, m[..., None])
     l = jnp.sum(p, axis=-1)
     o = jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32))
     o = o / jnp.where(l == 0, 1.0, l)[..., None]
@@ -212,7 +218,9 @@ def _flash_bwd_rule(scale, causal, block_q, block_k, res, do):
             rows = jax.lax.broadcasted_iota(jnp.int32, (sq, bk), 0)
             cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (sq, bk), 1)
             s = jnp.where((rows + (sk - sq))[None] >= cols[None], s, _NEG_INF)
-        p = jnp.exp(s - lse[..., None])  # exact probabilities
+        # exact probabilities; masked rows carry lse == _NEG_INF and must
+        # get p = 0, not exp(_NEG_INF - _NEG_INF) = 1
+        p = _masked_exp(s, lse[..., None])
         dv = jnp.einsum("bqk,bqd->bkd", p, do32)
         dp = jnp.einsum("bqd,bkd->bqk", do32, vs)
         ds = p * (dp - delta[..., None]) * scale
@@ -314,7 +322,7 @@ def ring_attention(
                 jnp.int32, (s_local, s_local), 1)
             s = jnp.where((rows >= cols)[None], s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[..., None])
+        p = _masked_exp(s, m_new[..., None])
         alpha = jnp.exp(m - m_new)
         l = alpha * l + jnp.sum(p, axis=-1)
         acc = acc * alpha[..., None] + jnp.einsum(

@@ -33,7 +33,6 @@ def main():
     params, bn_state = model.init(jax.random.PRNGKey(0))
 
     amp_state = amp.initialize("O2")  # bf16 compute, fp32 master, dyn scale
-    compute_params = amp_state.cast_model(params)
     scaler = amp_state.scaler
     scale_state = scaler.init()
 

@@ -70,17 +70,20 @@ class TestDistributedFusedAdam:
         @jax.jit
         def train_step(p, state):
             def inner(p, state):
+                # strip the leading per-device axis: each rank keeps ITS OWN
+                # exp_avg/exp_avg_sq shard across steps (P("data") on both
+                # specs), not a replicated copy of rank 0's
+                state = jax.tree_util.tree_map(lambda a: a[0], state)
                 grads = jax.tree_util.tree_map(lambda a, t: a - t, p, target)
                 new_p, new_s = dopt.step(grads, state, p, schema)
-                return new_p, new_s
-            return shard_map(inner, mesh=mesh, in_specs=(P(), P()),
-                             out_specs=(P(), P()), check_rep=False)(p, state)
+                return new_p, jax.tree_util.tree_map(lambda a: a[None], new_s)
+            return shard_map(inner, mesh=mesh, in_specs=(P(), P("data")),
+                             out_specs=(P(), P("data")),
+                             check_rep=False)(p, state)
 
-        state = shard_map(lambda p: dopt.init(p, schema, N_DEV), mesh=mesh,
-                          in_specs=P(), out_specs=P(), check_rep=False)(params)
-        # state comes back gathered over devices; reshape to per-device view
+        state0 = dopt.init(params, schema, N_DEV)
         state = jax.tree_util.tree_map(
-            lambda a: a if a.ndim == 0 else a, state)
+            lambda a: jnp.broadcast_to(a, (N_DEV, *a.shape)), state0)
 
         def dist(p):
             return sum(float(jnp.sum((p[k] - target[k]) ** 2)) for k in p)
