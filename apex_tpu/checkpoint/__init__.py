@@ -1,0 +1,26 @@
+"""Checkpoint/resume of the full train state (SURVEY.md §5.4).
+
+Usage::
+
+    from apex_tpu import checkpoint as ckpt
+
+    state = ckpt.TrainState.create(params, opt_state, scaler_state)
+    ckpt.save_checkpoint(dir, state, step=int(state.step), shardings=specs)
+    state, step = ckpt.restore_checkpoint(dir, target=state, mesh=mesh)
+"""
+
+from apex_tpu.checkpoint.checkpoint import (
+    latest_step,
+    restore_checkpoint,
+    save_checkpoint,
+    step_dir,
+)
+from apex_tpu.checkpoint.train_state import TrainState
+
+__all__ = [
+    "TrainState",
+    "save_checkpoint",
+    "restore_checkpoint",
+    "latest_step",
+    "step_dir",
+]

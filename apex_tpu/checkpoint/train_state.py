@@ -1,0 +1,52 @@
+"""Canonical train-state pytree for checkpoint/resume.
+
+The reference has no single train-state object — checkpoints are ad-hoc
+``torch.save`` dicts assembled in the examples
+(examples/imagenet/main_amp.py:178-193: model state_dict, optimizer
+state_dict, ``amp.state_dict()``, epoch, best_prec1). Here the same pieces
+are one registered pytree so the whole thing jits, shards, and checkpoints
+as a unit:
+
+- ``params``  — fp32 master params (reference O2 master weights,
+  _process_optimizer.py:28-90; precision-portable like ``O2StateDictHook``
+  _initialize.py:133-142)
+- ``opt_state`` — fused-optimizer state (m/v/momentum trees)
+- ``scaler_state`` — dynamic loss-scale state (reference
+  ``amp.state_dict()``: loss_scale + unskipped, frontend.py:361-370)
+- ``model_state`` — non-trained model state: BN running mean/var
+  (reference BN buffers travel in the model state_dict)
+- ``step`` — global step counter
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    """Everything needed to resume training exactly."""
+
+    step: jnp.ndarray  # i32 scalar
+    params: Any
+    opt_state: Any
+    scaler_state: Any = None
+    model_state: Any = None
+
+    @classmethod
+    def create(cls, params, opt_state, scaler_state=None, model_state=None, step=0):
+        return cls(
+            step=jnp.asarray(step, jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            scaler_state=scaler_state,
+            model_state=model_state,
+        )
+
+    def replace(self, **kw) -> "TrainState":
+        return dataclasses.replace(self, **kw)

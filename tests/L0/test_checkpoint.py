@@ -1,0 +1,235 @@
+"""Checkpoint/resume tests (SURVEY.md §5.4).
+
+Reference coverage being matched: amp state round-trip
+(tests/L0/run_amp/test_checkpointing.py), FP16_Optimizer master-weight
+state_dicts (fp16_optimizer.py:209-271), plus the TPU-design extensions:
+precision-portable fp32 storage and restore onto a different-size mesh.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from apex_tpu import amp
+from apex_tpu import checkpoint as ckpt
+from apex_tpu.amp import scaler as scaler_lib
+from apex_tpu.optimizers import FusedAdam
+
+
+def _toy_params(key):
+    k1, k2 = jax.random.split(key)
+    return {
+        "dense": {"w": jax.random.normal(k1, (8, 16), jnp.float32),
+                  "b": jnp.zeros((16,), jnp.float32)},
+        "out": {"w": jax.random.normal(k2, (16, 4), jnp.float32)},
+    }
+
+
+def _loss(params, x, y):
+    h = jnp.tanh(x @ params["dense"]["w"] + params["dense"]["b"])
+    logits = h @ params["out"]["w"]
+    return jnp.mean((logits - y) ** 2)
+
+
+def _make_step(opt, amp_state):
+    @jax.jit
+    def step(state, x, y):
+        def scaled_loss(p):
+            return amp_state.scaler.scale(_loss(p, x, y), state.scaler_state)
+
+        grads = jax.grad(scaled_loss)(state.params)
+        grads, finite = amp_state.scaler.unscale(grads, state.scaler_state)
+        new_p, new_o = opt.step_if_finite(grads, state.opt_state, state.params, finite)
+        return state.replace(
+            step=state.step + 1,
+            params=new_p,
+            opt_state=new_o,
+            scaler_state=amp_state.scaler.update(state.scaler_state, finite),
+        )
+
+    return step
+
+
+def _train(n_steps, state, step_fn, key):
+    for i in range(n_steps):
+        k = jax.random.fold_in(key, i)
+        x = jax.random.normal(k, (32, 8), jnp.float32)
+        y = jax.random.normal(jax.random.fold_in(k, 1), (32, 4), jnp.float32)
+        state = step_fn(state, x, y)
+    return state
+
+
+def test_round_trip_exact(tmp_path):
+    params = _toy_params(jax.random.PRNGKey(0))
+    opt = FusedAdam(lr=1e-2)
+    amp_state = amp.initialize("O2")
+    state = ckpt.TrainState.create(params, opt.init(params), amp_state.scaler.init())
+    state = _train(3, state, _make_step(opt, amp_state), jax.random.PRNGKey(1))
+
+    ckpt.save_checkpoint(str(tmp_path), state, step=int(state.step))
+    restored, step = ckpt.restore_checkpoint(str(tmp_path), target=state)
+    assert step == 3
+    for a, b in zip(jax.tree_util.tree_leaves(state), jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # NamedTuple / dataclass structure survives
+    assert isinstance(restored, ckpt.TrainState)
+    assert restored.scaler_state.loss_scale == state.scaler_state.loss_scale
+
+
+def test_resume_continues_trajectory_bitwise(tmp_path):
+    """3 steps + save/restore + 3 steps == 6 straight steps, bitwise.
+
+    The trajectory-parity discipline of the reference L1 tier
+    (tests/L1/common/compare.py:40-64) applied to resume.
+    """
+    params = _toy_params(jax.random.PRNGKey(0))
+    opt = FusedAdam(lr=1e-2, weight_decay=0.01)
+    amp_state = amp.initialize("O2")
+    step_fn = _make_step(opt, amp_state)
+    key = jax.random.PRNGKey(7)
+
+    s0 = ckpt.TrainState.create(params, opt.init(params), amp_state.scaler.init())
+    straight = _train(6, s0, step_fn, key)
+
+    half = _train(3, s0, step_fn, key)
+    ckpt.save_checkpoint(str(tmp_path), half, step=3)
+    resumed, _ = ckpt.restore_checkpoint(str(tmp_path), target=half)
+    # continue with the same per-step data keys (fold_in i=3..5)
+    for i in range(3, 6):
+        k = jax.random.fold_in(key, i)
+        x = jax.random.normal(k, (32, 8), jnp.float32)
+        y = jax.random.normal(jax.random.fold_in(k, 1), (32, 4), jnp.float32)
+        resumed = step_fn(resumed, x, y)
+
+    for a, b in zip(jax.tree_util.tree_leaves(straight), jax.tree_util.tree_leaves(resumed)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_precision_portable_fp32_on_disk(tmp_path):
+    """bf16 leaves are stored fp32 (O2StateDictHook parity,
+    _initialize.py:133-142) and restore to the target's dtype."""
+    tree = {"w": jnp.arange(6, dtype=jnp.bfloat16).reshape(2, 3) / 3.0,
+            "b": jnp.ones((3,), jnp.float32)}
+    ckpt.save_checkpoint(str(tmp_path), tree, step=0)
+
+    import numpy as _np
+    with _np.load(str(tmp_path) + "/step_0000000000/arrays.npz") as z:
+        stored = {k: z[k].dtype for k in z.files}
+    assert all(dt == _np.float32 for dt in stored.values())
+
+    # restore into a bf16 target -> bf16; into an fp32 target -> fp32
+    back, _ = ckpt.restore_checkpoint(str(tmp_path), target=tree)
+    assert back["w"].dtype == jnp.bfloat16
+    fp32_target = jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), tree)
+    back32, _ = ckpt.restore_checkpoint(str(tmp_path), target=fp32_target)
+    assert back32["w"].dtype == jnp.float32
+    np.testing.assert_allclose(
+        np.asarray(back32["w"]), np.asarray(tree["w"], dtype=np.float32))
+
+
+def test_restore_on_different_mesh_size(tmp_path):
+    """Save under an 8-way dp mesh, restore onto a 4-way mesh — the
+    restart-on-different-topology design of SURVEY §5.4 (impossible with the
+    reference's per-rank torch.save)."""
+    devs = jax.devices()
+    assert len(devs) >= 8
+    mesh8 = Mesh(np.array(devs[:8]), ("data",))
+    specs = {"w": P("data", None), "b": P()}
+    w = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+    b = jnp.ones((8,), jnp.float32)
+    tree = {
+        "w": jax.device_put(w, NamedSharding(mesh8, specs["w"])),
+        "b": jax.device_put(b, NamedSharding(mesh8, specs["b"])),
+    }
+    ckpt.save_checkpoint(str(tmp_path), tree, step=10, shardings=specs)
+
+    mesh4 = Mesh(np.array(devs[:4]), ("data",))
+    restored, step = ckpt.restore_checkpoint(
+        str(tmp_path), target=tree, mesh=mesh4, shardings=specs)
+    assert step == 10
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.asarray(w))
+    assert restored["w"].sharding.mesh.shape["data"] == 4
+
+    # manifest specs alone (no shardings arg) also work
+    restored2, _ = ckpt.restore_checkpoint(str(tmp_path), target=tree, mesh=mesh4)
+    assert restored2["w"].sharding.spec == P("data", None)
+
+
+def test_latest_step_and_keep(tmp_path):
+    tree = {"x": jnp.zeros((2,))}
+    for s in (1, 2, 3, 4):
+        ckpt.save_checkpoint(str(tmp_path), tree, step=s, keep=2)
+    assert ckpt.latest_step(str(tmp_path)) == 4
+    import os
+    kept = sorted(n for n in os.listdir(tmp_path) if n.startswith("step_"))
+    assert len(kept) == 2
+
+
+def test_restore_without_target_nested_dict(tmp_path):
+    tree = {"a": {"b": jnp.ones((2, 2)), "c": jnp.zeros((3,))}, "d": jnp.asarray(5)}
+    ckpt.save_checkpoint(str(tmp_path), tree, step=0)
+    out, _ = ckpt.restore_checkpoint(str(tmp_path))
+    np.testing.assert_array_equal(np.asarray(out["a"]["b"]), np.ones((2, 2)))
+    np.testing.assert_array_equal(np.asarray(out["d"]), 5)
+
+
+def test_raw_half_storage_round_trips(tmp_path):
+    """fp32_portable=False keeps bf16 bits exactly (stored as a uint16 view)."""
+    tree = {"w": (jnp.arange(7, dtype=jnp.bfloat16) / 3.0)}
+    ckpt.save_checkpoint(str(tmp_path), tree, step=0, fp32_portable=False)
+    back, _ = ckpt.restore_checkpoint(str(tmp_path), target=tree)
+    assert back["w"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(back["w"]).view(np.uint16), np.asarray(tree["w"]).view(np.uint16))
+
+
+def test_latest_step_survives_crash_artifacts(tmp_path):
+    import os
+    tree = {"x": jnp.zeros((2,))}
+    ckpt.save_checkpoint(str(tmp_path), tree, step=2)
+    # a save that died mid-write: .tmp dir with a manifest + truncated marker
+    os.makedirs(tmp_path / "step_0000000003.tmp")
+    (tmp_path / "step_0000000003.tmp" / "manifest.json").write_text("{}")
+    (tmp_path / "latest").write_text("")
+    (tmp_path / "step_junk").mkdir()
+    assert ckpt.latest_step(str(tmp_path)) == 2
+    restored, step = ckpt.restore_checkpoint(str(tmp_path), target=tree)
+    assert step == 2
+
+
+def test_keep_never_deletes_just_written_rollback(tmp_path):
+    """Rollback-resume: saving a LOWER step than what's on disk with keep=1
+    must keep the new save, pruning by recency not step number."""
+    import os
+    tree = {"x": jnp.zeros((2,))}
+    ckpt.save_checkpoint(str(tmp_path), tree, step=5)
+    path = ckpt.save_checkpoint(str(tmp_path), tree, step=3, keep=1)
+    assert os.path.exists(path)
+    assert ckpt.latest_step(str(tmp_path)) == 3
+    assert not os.path.exists(ckpt.step_dir(str(tmp_path), 5))
+
+
+def test_prefix_shardings_broadcast(tmp_path):
+    """A PartitionSpec given at a subtree root applies to every leaf under it
+    (pjit in_shardings broadcast rule)."""
+    import json
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs[:4]), ("data",))
+    tree = {"params": {"w": jnp.zeros((8, 2)), "v": jnp.zeros((8,))}}
+    ckpt.save_checkpoint(str(tmp_path), tree, step=0,
+                         shardings={"params": P("data")})
+    with open(str(tmp_path) + "/step_0000000000/manifest.json") as f:
+        man = json.load(f)
+    assert all(e["spec"] == ["data"] for e in man["leaves"].values())
+    restored, _ = ckpt.restore_checkpoint(
+        str(tmp_path), target=tree, mesh=mesh, shardings={"params": P("data")})
+    assert restored["params"]["w"].sharding.spec == P("data")
+
+
+def test_missing_leaf_errors(tmp_path):
+    ckpt.save_checkpoint(str(tmp_path), {"x": jnp.zeros((2,))}, step=0)
+    with pytest.raises(KeyError):
+        ckpt.restore_checkpoint(str(tmp_path), target={"x": jnp.zeros((2,)),
+                                                       "y": jnp.zeros((2,))})

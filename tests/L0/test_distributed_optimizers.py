@@ -61,6 +61,30 @@ class TestDistributedFusedAdam:
         for k in params:
             np.testing.assert_allclose(out[k], ref_p[k], rtol=1e-5, atol=1e-6)
 
+    def test_matches_unsharded_classic_adam_l2_decay(self, mesh):
+        """adam_w_mode=False: L2 decay folds into the grad BEFORE the moment
+        updates (reference AdamFunctor ADAM_MODE_1, multi_tensor_adam.cu)."""
+        params = _params(jax.random.PRNGKey(2))
+        grads = _params(jax.random.PRNGKey(3))
+
+        dopt = DistributedFusedAdam(lr=1e-2, weight_decay=0.05,
+                                    adam_w_mode=False)
+        schema = dopt.make_schema(params, N_DEV)
+
+        def step_fn(p, g):
+            state = dopt.init(p, schema, N_DEV)
+            new_p, _ = dopt.step(g, state, p, schema)
+            return new_p
+
+        out = shard_map(step_fn, mesh=mesh, in_specs=(P(), P()),
+                        out_specs=P(), check_rep=False)(params, grads)
+
+        ref_opt = optimizers.FusedAdam(lr=1e-2, weight_decay=0.05,
+                                       adam_w_mode=False)
+        ref_p, _ = ref_opt.step(grads, ref_opt.init(params), params)
+        for k in params:
+            np.testing.assert_allclose(out[k], ref_p[k], rtol=1e-5, atol=1e-6)
+
     def test_multi_step_convergence(self, mesh):
         params = _params(jax.random.PRNGKey(0))
         target = _params(jax.random.PRNGKey(7))
