@@ -172,10 +172,10 @@ def save_checkpoint(
     manifest = {"step": int(step), "format": 1, "leaves": {}}
     arrays = {}
     for path, leaf in leaves:
+        # (None leaves never appear here: tree_flatten treats None as an
+        # empty subtree, so None-valued fields are simply absent and
+        # reappear from the target's structure on restore)
         key = _keystr(path)
-        if leaf is None:
-            manifest["leaves"][key] = {"kind": "none"}
-            continue
         val = np.asarray(jax.device_get(leaf))
         entry = {"kind": "array", "dtype": str(val.dtype), "shape": list(val.shape)}
         if str(val.dtype) in _HALF_DTYPES:
@@ -269,8 +269,6 @@ def restore_checkpoint(
         spec_map = {}
 
     def _materialize(key: str, entry: dict, want_dtype=None):
-        if entry["kind"] == "none":
-            return None
         val = data[key]
         if entry.get("stored_dtype") == "uint16_bits":
             val = val.view(jnp.dtype(entry["dtype"]))
@@ -283,10 +281,9 @@ def restore_checkpoint(
             if spec is None:
                 spec = PartitionSpec()
             # drop axis names the new mesh doesn't have (e.g. restoring a
-            # dp-sharded save onto a single-axis mesh)
-            spec = PartitionSpec(
-                *[p if _spec_axes_in_mesh(p, mesh) else None for p in spec]
-            )
+            # dp-sharded save onto a single-axis mesh); tuple entries keep
+            # whichever of their axes still exist
+            spec = PartitionSpec(*[_filter_spec_entry(p, mesh) for p in spec])
             arr = jax.device_put(arr, NamedSharding(mesh, spec))
         return arr
 
@@ -309,11 +306,15 @@ def restore_checkpoint(
     return jax.tree_util.tree_unflatten(treedef, leaves), step
 
 
-def _spec_axes_in_mesh(part, mesh: Mesh) -> bool:
+def _filter_spec_entry(part, mesh: Mesh):
+    """Keep only the axis names present in ``mesh`` for one PartitionSpec
+    dimension entry (None / name / tuple-of-names)."""
     if part is None:
-        return True
-    names = part if isinstance(part, (tuple, list)) else (part,)
-    return all(n in mesh.axis_names for n in names)
+        return None
+    if isinstance(part, (tuple, list)):
+        kept = tuple(n for n in part if n in mesh.axis_names)
+        return kept if kept else None
+    return part if part in mesh.axis_names else None
 
 
 def _nest(flat: dict) -> dict:

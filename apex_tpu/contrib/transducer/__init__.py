@@ -1,0 +1,10 @@
+"""RNN-T transducer joint + loss (reference apex/contrib/transducer/)."""
+
+from apex_tpu.contrib.transducer.transducer import (
+    TransducerJoint,
+    TransducerLoss,
+    transducer_joint,
+    transducer_loss,
+)
+
+__all__ = ["TransducerJoint", "TransducerLoss", "transducer_joint", "transducer_loss"]

@@ -1,0 +1,366 @@
+"""RNN-T transducer joint + loss, TPU-native.
+
+Capability parity with the reference transducer extension
+(apex/contrib/transducer/transducer.py:5-196 over ~1,906 LoC of CUDA in
+apex/contrib/csrc/transducer/), re-designed for XLA:
+
+- **Joint** (`TransducerJoint`, reference transducer.py:5-68): the fused
+  "f + g outer sum (+ relu, + dropout, + packing)" — here a broadcast add
+  that XLA fuses with the epilogue; packing is a static-shape scatter
+  (compact output for variable (f_len, g_len), same batch_offset contract
+  as the reference).
+- **Loss** (`TransducerLoss`, reference transducer.py:70-196): alpha/beta
+  dynamic programming over the (T, U) lattice. The CUDA kernels walk the
+  lattice with per-batch thread blocks; here both DPs run as ONE
+  `lax.scan` over anti-diagonals (wavefront parallelism: every cell of a
+  diagonal is independent, vectorized over batch x diagonal on the VPU),
+  over pre-sheared transition matrices so each step is a contiguous slice,
+  not a gather.
+- The backward is a `custom_vjp` with the **analytic** alpha-beta gradient
+  fused with the softmax backward (reference ``fuse_softmax_backward=True``
+  path, transducer.py:133-162): one pass producing dL/dx directly from
+  (x_log, alpha, beta) — no saved softmax output, no second DP.
+
+Numerics note: invalid lattice transitions carry ``_NEG_INF = -1e30``
+(not literal -inf) so fp32 sums stay finite; ``exp`` of them underflows
+to exactly 0.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1.0e30
+
+
+# ---------------------------------------------------------------------------
+# Joint
+# ---------------------------------------------------------------------------
+
+
+def transducer_joint(
+    f,
+    g,
+    f_len,
+    g_len,
+    *,
+    pack_output: bool = False,
+    relu: bool = False,
+    dropout_prob: float = 0.0,
+    dropout_key: Optional[jax.Array] = None,
+    batch_offset=None,
+    packed_batch: int = 0,
+):
+    """Transducer joint: ``h[b,t,u] = f[b,t] + g[b,u]`` with optional fused
+    relu/dropout and optional packing (reference TransducerJointFunc,
+    transducer.py:164-196).
+
+    f: (B, T, H) transcription (encoder) vectors.
+    g: (B, U, H) prediction (decoder) vectors; ``g_len = y_len + 1``.
+    Don't-care cells (t >= f_len or u >= g_len) are zeroed (the reference
+    kernel leaves them unwritten; zero keeps AD NaN-free).
+
+    With ``pack_output=True``, ``batch_offset = cumsum(f_len * g_len)`` and
+    ``packed_batch`` (a static int >= batch_offset[-1]) must be given —
+    same contract as the reference (transducer.py:43-66) — and the result
+    is (packed_batch, H).
+    """
+    B, T, H = f.shape
+    U = g.shape[1]
+    h = f[:, :, None, :] + g[:, None, :, :]
+    if relu:
+        h = jax.nn.relu(h)
+    if dropout_prob:
+        if dropout_key is None:
+            raise ValueError("dropout_prob > 0 requires dropout_key")
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_prob, h.shape)
+        h = jnp.where(keep, h / (1.0 - dropout_prob), 0.0)
+    valid = (jnp.arange(T)[None, :, None] < f_len[:, None, None]) & (
+        jnp.arange(U)[None, None, :] < g_len[:, None, None]
+    )
+    h = jnp.where(valid[..., None], h, 0.0)
+    if not pack_output:
+        return h
+    if batch_offset is None or not packed_batch:
+        raise ValueError("pack_output=True requires batch_offset and packed_batch")
+    return _pack(h, f_len, g_len, batch_offset, packed_batch, valid)
+
+
+def _pack(h, f_len, g_len, batch_offset, packed_batch: int, valid):
+    """Scatter the valid (b,t,u) cells of ``h`` into a compact
+    (packed_batch, H) buffer: dest = batch_offset[b-1] + t*g_len[b] + u."""
+    B, T, U, H = h.shape
+    start = batch_offset - f_len * g_len  # offset of batch b's first cell
+    t_idx = jnp.arange(T)[None, :, None]
+    u_idx = jnp.arange(U)[None, None, :]
+    dest = start[:, None, None] + t_idx * g_len[:, None, None] + u_idx
+    # invalid cells scatter out of bounds and are dropped
+    dest = jnp.where(valid, dest, packed_batch)
+    out = jnp.zeros((packed_batch, H), h.dtype)
+    return out.at[dest.reshape(-1)].set(h.reshape(-1, H), mode="drop")
+
+
+def _unpack(x_packed, f_len, g_len, batch_offset, B: int, T: int, U: int):
+    """Inverse of :func:`_pack` (gather); used to adapt packed loss inputs
+    to the dense lattice layout the DP wants."""
+    start = batch_offset - f_len * g_len
+    t_idx = jnp.arange(T)[None, :, None]
+    u_idx = jnp.arange(U)[None, None, :]
+    src = start[:, None, None] + t_idx * g_len[:, None, None] + u_idx
+    valid = (t_idx < f_len[:, None, None]) & (u_idx < g_len[:, None, None])
+    src = jnp.where(valid, src, 0)
+    out = x_packed[src.reshape(-1)].reshape(B, T, U, x_packed.shape[-1])
+    return jnp.where(valid[..., None], out, 0.0)
+
+
+class TransducerJoint:
+    """Module-style wrapper mirroring the reference class
+    (transducer.py:5-68). ``opt``/``fwd_tile_size`` are accepted for API
+    parity and ignored — tiling is XLA's job."""
+
+    def __init__(self, pack_output=False, relu=False, dropout=False, opt=1,
+                 fwd_tile_size=4, dropout_prob=0.0, probe_mask=False):
+        del opt, fwd_tile_size
+        self.pack_output = pack_output
+        self.relu = relu
+        self.dropout = dropout
+        self.dropout_prob = dropout_prob
+        if probe_mask:
+            raise NotImplementedError("probe_mask: pass dropout_key and regenerate the mask")
+
+    def __call__(self, f, g, f_len, g_len, batch_offset=None, packed_batch=0,
+                 dropout_key=None, training=True):
+        p = self.dropout_prob if (self.dropout and training) else 0.0
+        return transducer_joint(
+            f, g, f_len, g_len,
+            pack_output=self.pack_output, relu=self.relu, dropout_prob=p,
+            dropout_key=dropout_key, batch_offset=batch_offset,
+            packed_batch=packed_batch,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Loss: alpha/beta wavefront DP
+# ---------------------------------------------------------------------------
+
+
+def _shear(m, fill):
+    """(B, T, U) -> (D, B, T) with D = T+U-1, sheared so that
+    ``out[d, b, t] = m[b, t, d - t]`` (anti-diagonal d as a contiguous
+    slice). Cells off the lattice get ``fill``."""
+    B, T, U = m.shape
+    D = T + U - 1
+    d = jnp.arange(D)[:, None]
+    t = jnp.arange(T)[None, :]
+    u = d - t  # (D, T)
+    ok = (u >= 0) & (u < U)
+    gathered = m[:, t, jnp.clip(u, 0, U - 1)]  # (B, D, T)
+    return jnp.where(ok[None], gathered, fill).transpose(1, 0, 2)
+
+
+def _unshear(diags, U: int):
+    """(D, B, T) diagonals -> (B, T, U): ``out[b, t, u] = diags[t+u, b, t]``."""
+    D, B, T = diags.shape
+    t = jnp.arange(T)[:, None]
+    u = jnp.arange(U)[None, :]
+    return diags.transpose(1, 2, 0)[:, t, t + u]  # (B, T, U) via gather on d
+
+
+def _wavefront(V, H, init):
+    """Run the lattice recurrence
+
+        a[t, u] = logaddexp(a[t-1, u] + V[t, u],  a[t, u-1] + H[t, u])
+
+    with ``a[0, 0] = init`` (per batch), V/H of shape (B, T, U) already
+    encoding boundary -infs. Returns the full ``a`` (B, T, U).
+
+    One ``lax.scan`` over the T+U-1 anti-diagonals; each step is two
+    shifted adds + a logaddexp over a (B, T) slab — wavefront parallelism,
+    the XLA analog of the reference's per-diagonal CUDA grid sync.
+    """
+    B, T, U = V.shape
+    Vs = _shear(V, _NEG_INF)  # (D, B, T)
+    Hs = _shear(H, _NEG_INF)
+
+    diag0 = jnp.full((B, T), _NEG_INF).at[:, 0].set(init)
+
+    def step(prev, vh):
+        v_d, h_d = vh
+        from_top = jnp.concatenate(
+            [jnp.full((B, 1), _NEG_INF), prev[:, :-1]], axis=1
+        ) + v_d  # a[t-1, u] + V[t, u]
+        from_left = prev + h_d  # a[t, u-1] + H[t, u]
+        new = jnp.logaddexp(from_top, from_left)
+        return new, new
+
+    _, diags = jax.lax.scan(step, diag0, (Vs[1:], Hs[1:]))
+    diags = jnp.concatenate([diag0[None], diags], axis=0)  # (D, B, T)
+    return _unshear(diags, U)
+
+
+def _lattice_terms(x_log, label, blank_idx):
+    """blank[b,t,u] = x_log[...,blank]; emit[b,t,u] = x_log[b,t,u,label[b,u]]
+    (emit at u = U-1 is never a valid transition; filled with -inf)."""
+    B, T, U, V = x_log.shape
+    blank = x_log[..., blank_idx]
+    lbl = jnp.concatenate([label[:, : U - 1], jnp.zeros((B, 1), label.dtype)], axis=1)
+    emit = jnp.take_along_axis(
+        x_log, jnp.broadcast_to(lbl[:, None, :, None], (B, T, U, 1)), axis=-1
+    )[..., 0]
+    emit = emit.at[:, :, U - 1].set(_NEG_INF)
+    return blank, emit
+
+
+def _alpha_beta(x_log, label, f_len, y_len, blank_idx):
+    """Both DPs (reference forward_alpha/forward_beta in
+    contrib/test/transducer/transducer_ref.py are the spec; the CUDA
+    kernels in contrib/csrc/transducer compute the same lattice)."""
+    B, T, U, V = x_log.shape
+    blank, emit = _lattice_terms(x_log, label, blank_idx)
+    t_ax = jnp.arange(T)[None, :, None]
+    u_ax = jnp.arange(U)[None, None, :]
+
+    # ----- alpha: transitions INTO (t,u) read the source cell -----
+    # vertical (t-1,u)->(t,u) weight blank[t-1,u]; horizontal emit[t,u-1]
+    Va = jnp.concatenate([jnp.full((B, 1, U), _NEG_INF), blank[:, :-1]], axis=1)
+    Ha = jnp.concatenate([jnp.full((B, T, 1), _NEG_INF), emit[:, :, :-1]], axis=2)
+    alpha = _wavefront(Va, Ha, jnp.zeros((B,)))
+
+    # ----- beta: reverse per-batch around (f_len-1, y_len) -----
+    # beta'[t',u'] = beta[f_len-1-t', y_len-u'] turns the backward DP into
+    # the same forward wavefront with dest-cell weights.
+    rt = jnp.clip(f_len[:, None, None] - 1 - t_ax, 0, T - 1)  # (B,T,1)
+    ru = jnp.clip(y_len[:, None, None] - u_ax, 0, U - 1)  # (B,1,U)
+    gather = lambda m: m[jnp.arange(B)[:, None, None], rt, ru]
+    blank_r, emit_r = gather(blank), gather(emit)
+    in_lat = (t_ax < f_len[:, None, None]) & (u_ax <= y_len[:, None, None])
+    Vb = jnp.where(in_lat, blank_r, _NEG_INF)
+    Hb = jnp.where(in_lat, emit_r, _NEG_INF)
+    # beta'[0,0] = blank[f_len-1, y_len]
+    init_b = blank[jnp.arange(B), f_len - 1, y_len]
+    beta_rev = _wavefront(Vb, Hb, init_b)
+    # un-reverse: beta[t,u] = beta'[f_len-1-t, y_len-u] (invalid cells -> -inf)
+    beta = gather(beta_rev)
+    beta = jnp.where(in_lat, beta, _NEG_INF)
+    return alpha, beta
+
+
+from functools import partial
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _loss_from_logits(x, label, f_len, y_len, blank_idx):
+    y = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+    _, beta = _alpha_beta(y, label, f_len, y_len, blank_idx)
+    return -beta[:, 0, 0]
+
+
+def _loss_fwd(x, label, f_len, y_len, blank_idx):
+    y = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+    alpha, beta = _alpha_beta(y, label, f_len, y_len, blank_idx)
+    # save x (input precision), not the fp32 log-softmax: for bf16 logits —
+    # the dominant (B,T,U,V) activation — that halves residual memory; the
+    # backward recomputes the softmax (one cheap VPU pass)
+    return -beta[:, 0, 0], (x, alpha, beta, label, f_len, y_len)
+
+
+def _loss_bwd(blank_idx, res, loss_grad):
+    """Analytic gradient fused with the softmax backward (reference
+    fuse_softmax_backward path: transducer.py:133-141 + the
+    transducer_loss_cuda.backward kernel; math per
+    contrib/test/transducer/transducer_ref.py backward())."""
+    x, alpha, beta, label, f_len, y_len = res
+    in_dtype = x.dtype
+    y = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+    B, T, U, V = y.shape
+    t_ax = jnp.arange(T)[None, :, None]
+    u_ax = jnp.arange(U)[None, None, :]
+    f = f_len[:, None, None]
+    yl = y_len[:, None, None]
+    common = alpha - beta[:, :1, :1]  # alpha[t,u] - beta[0,0]
+    blank, emit = _lattice_terms(y, label, blank_idx)
+
+    # d(-loss)/d(y) per lattice cell, before the softmax-backward correction
+    # emit arcs: (t, u) -> (t, u+1) for u < y_len, t < f_len
+    g_emit = -jnp.exp(
+        common
+        + jnp.concatenate([beta[:, :, 1:], jnp.full((B, T, 1), _NEG_INF)], axis=2)
+        + emit
+    )
+    g_emit = jnp.where((u_ax < yl) & (t_ax < f), g_emit, 0.0)
+    # blank arcs: (t, u) -> (t+1, u) for t < f_len-1, u <= y_len
+    g_blank = -jnp.exp(
+        common
+        + jnp.concatenate([beta[:, 1:], jnp.full((B, 1, U), _NEG_INF)], axis=1)
+        + blank
+    )
+    g_blank = jnp.where((t_ax < f - 1) & (u_ax <= yl), g_blank, 0.0)
+    # terminal blank at (f_len-1, y_len)
+    term = -jnp.exp(common + blank)
+    g_blank = jnp.where((t_ax == f - 1) & (u_ax == yl), term, g_blank)
+
+    lbl = jnp.concatenate([label[:, : U - 1], jnp.zeros((B, 1), label.dtype)], axis=1)
+    g_y = jnp.zeros((B, T, U, V), jnp.float32)
+    g_y = g_y.at[..., blank_idx].add(g_blank)
+    g_y = g_y + g_emit[..., None] * jax.nn.one_hot(lbl, V, dtype=jnp.float32)[:, None]
+
+    # fused log-softmax backward: dL/dx = g_y - exp(y) * sum_v g_y
+    g_x = g_y - jnp.exp(y) * jnp.sum(g_y, axis=-1, keepdims=True)
+    g_x = g_x * loss_grad[:, None, None, None]
+    return (g_x.astype(in_dtype), None, None, None)
+
+
+_loss_from_logits.defvjp(_loss_fwd, _loss_bwd)
+
+
+def transducer_loss(
+    x,
+    label,
+    f_len,
+    y_len,
+    blank_idx: int,
+    *,
+    packed_input: bool = False,
+    batch_offset=None,
+    max_f_len: Optional[int] = None,
+    g_len=None,
+):
+    """Per-sequence RNN-T loss (B,) = -log P(label | x).
+
+    x: (B, T, U, V) joint logits (U = max y_len + 1), or packed (N, V) when
+    ``packed_input`` (then ``batch_offset = cumsum(f_len*(y_len+1))``,
+    ``max_f_len`` static, matching reference transducer.py:96-129).
+    """
+    if packed_input:
+        if batch_offset is None or max_f_len is None:
+            raise ValueError("packed_input requires batch_offset and max_f_len")
+        B = label.shape[0]
+        U = label.shape[1] + 1
+        gl = y_len + 1 if g_len is None else g_len
+        x = _unpack(x, f_len, gl, batch_offset, B, max_f_len, U)
+    blank_idx = int(blank_idx)
+    return _loss_from_logits(x, label, f_len, y_len, blank_idx)
+
+
+class TransducerLoss:
+    """Module-style wrapper (reference transducer.py:70-129).
+    ``fuse_softmax_backward`` / ``opt`` accepted for parity; the fused path
+    is the only path here."""
+
+    def __init__(self, fuse_softmax_backward=True, opt=1, packed_input=False):
+        del fuse_softmax_backward, opt
+        self.packed_input = packed_input
+
+    def __call__(self, x, label, f_len, y_len, blank_idx, batch_offset=None,
+                 max_f_len=None, debug_list=None):
+        if debug_list is not None:
+            y = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+            debug_list += list(_alpha_beta(y, label, f_len, y_len, int(blank_idx)))
+        return transducer_loss(
+            x, label, f_len, y_len, blank_idx,
+            packed_input=self.packed_input, batch_offset=batch_offset,
+            max_f_len=max_f_len,
+        )
