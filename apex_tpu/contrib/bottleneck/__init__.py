@@ -1,0 +1,11 @@
+"""Bottleneck block + spatial (H-dim) parallelism
+(reference apex/contrib/bottleneck/)."""
+
+from apex_tpu.contrib.bottleneck.bottleneck import (
+    Bottleneck,
+    SpatialBottleneck,
+    halo_exchange,
+    spatial_conv2d,
+)
+
+__all__ = ["Bottleneck", "SpatialBottleneck", "halo_exchange", "spatial_conv2d"]

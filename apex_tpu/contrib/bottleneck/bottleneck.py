@@ -1,0 +1,186 @@
+"""ResNet bottleneck block + spatial (H-dim) parallelism.
+
+Capability parity with the reference contrib bottleneck
+(apex/contrib/bottleneck/bottleneck.py: ``Bottleneck`` :64-216 and
+``SpatialBottleneck`` :218-510 over csrc/bottleneck/bottleneck.cpp, 2,486
+LoC of cuDNN-frontend fused conv-scale-bias-relu), re-designed for TPU:
+
+- The block is conv1x1 → conv3x3(stride) → conv1x1, each followed by a
+  *frozen-BN* affine (scale·y + bias) and relu, with a residual add (and an
+  optional strided 1x1 downsample path). The reference fuses
+  conv+scale+bias+relu via cuDNN runtime fusion; XLA's epilogue fusion does
+  the same from the plain expression — no hand-built graph needed.
+- **Spatial parallelism**: the reference shards the H dimension across a
+  process group and hand-rolls a halo exchange for the 3x3 conv — an
+  allgather of 2-row halo buffers plus dedicated halo-conv kernel launches
+  on a side stream (bottleneck.py:239-268), with mirrored halo terms in
+  dgrad/wgrad (:289-510). Here each rank's halo rows move with two
+  ``lax.ppermute`` steps over the mesh axis and the 3x3 conv runs once on
+  the halo-extended shard with VALID padding in H. Gradients need no
+  hand-written halo path at all: the transpose of ``ppermute`` is the
+  reverse ``ppermute``, so AD derives the reference's backward halo
+  exchange automatically.
+
+Halo geometry: XLA "SAME" padding is TF-style — for kernel k and stride s
+the total pad is k−s (k≥s), split pad_lo = (k−s)//2, pad_hi = k−s−pad_lo.
+For k=3, s=1 that is (1, 1); for k=3, s=2 it is **(0, 1)** — asymmetric.
+The halo exchange mirrors exactly that: ``halo_lo`` rows from the rank
+above, ``halo_hi`` from the rank below, with global-edge ranks receiving
+zeros (ppermute's no-source default == the conv's zero padding).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_DIMNUMS = ("NHWC", "HWIO", "NHWC")
+
+
+def halo_exchange(x, axis_name: str, halo_lo: int = 1, halo_hi: int = 1):
+    """Extend an H-sharded NHWC shard with neighbor rows.
+
+    (N, H_local, W, C) → (N, halo_lo + H_local + halo_hi, W, C).
+    Ranks at the global edge receive zeros (ppermute leaves targets with no
+    source at zero), matching SAME-conv zero padding. TPU mapping of the
+    reference's send-buffer + all_gather halo path (bottleneck.py:243-252):
+    two point-to-point ``ppermute`` streams over ICI instead of a gather of
+    every rank's halos.
+    """
+    n = lax.psum(1, axis_name)
+    parts = []
+    if halo_lo:
+        # my bottom rows become the rank below's top halo
+        btm = x[:, -halo_lo:]
+        parts.append(lax.ppermute(btm, axis_name, [(i, i + 1) for i in range(n - 1)]))
+    parts.append(x)
+    if halo_hi:
+        # my top rows become the rank above's bottom halo
+        top = x[:, :halo_hi]
+        parts.append(lax.ppermute(top, axis_name, [(i, i - 1) for i in range(1, n)]))
+    return jnp.concatenate(parts, axis=1) if len(parts) > 1 else x
+
+
+def spatial_conv2d(x, w, *, stride: int = 1, axis_name: Optional[str] = None):
+    """2-D conv (NHWC · HWIO), SAME-padded globally, with the H dimension
+    optionally sharded over ``axis_name``.
+
+    Unsharded it is a plain ``conv_general_dilated``. Sharded, the halo
+    exchange supplies exactly the rows SAME padding would read across the
+    shard boundary, and the conv runs VALID in H. Requires
+    ``H_local % stride == 0`` (same contract as the reference's equal
+    H-split across the spatial group).
+    """
+    kh, kw = w.shape[0], w.shape[1]
+    if axis_name is None:
+        return lax.conv_general_dilated(
+            x, w, (stride, stride), "SAME", dimension_numbers=_DIMNUMS
+        )
+    if x.shape[1] % stride:
+        raise ValueError("local H must be divisible by the stride")
+    # TF-SAME split for kernel k, stride s (input divisible by s):
+    # total = k - s, lo = total // 2 — asymmetric when strided
+    pad_h = max(kh - stride, 0)
+    halo_lo, halo_hi = pad_h // 2, pad_h - pad_h // 2
+    pad_w = max(kw - stride, 0)
+    xh = halo_exchange(x, axis_name, halo_lo, halo_hi)
+    return lax.conv_general_dilated(
+        xh,
+        w,
+        (stride, stride),
+        [(0, 0), (pad_w // 2, pad_w - pad_w // 2)],
+        dimension_numbers=_DIMNUMS,
+    )
+
+
+def _scale_bias_relu(y, scale, bias, relu=True):
+    y = y * scale.astype(y.dtype) + bias.astype(y.dtype)
+    return jax.nn.relu(y) if relu else y
+
+
+class SpatialBottleneck:
+    """Bottleneck block with optional H-dim spatial parallelism.
+
+    ``axis_name=None`` reproduces the reference ``Bottleneck``
+    (bottleneck.py:64-216); with an axis name it is ``SpatialBottleneck``
+    (:218-510) — same parameters, H-sharded input/output shards.
+
+    Frozen-BN semantics as the reference: BN is folded to per-channel
+    (scale, bias); there are no running stats (the use case is
+    detection-style fine-tuning with frozen BN).
+    ``stride_1x1=True`` places the stride on the first 1x1 conv
+    (reference arg, bottleneck.py:77 ``use_cudnn_bottleneck`` path); False
+    (torchvision style) strides the 3x3.
+    """
+
+    def __init__(self, in_channels: int, bottleneck_channels: int,
+                 out_channels: int, stride: int = 1, stride_1x1: bool = False,
+                 axis_name: Optional[str] = None):
+        self.in_channels = in_channels
+        self.bottleneck_channels = bottleneck_channels
+        self.out_channels = out_channels
+        self.stride = stride
+        self.stride_1x1 = stride_1x1
+        self.axis_name = axis_name
+        self.has_downsample = stride != 1 or in_channels != out_channels
+
+    def init(self, key, dtype=jnp.float32):
+        c_in, c_b, c_out = self.in_channels, self.bottleneck_channels, self.out_channels
+        ks = jax.random.split(key, 4)
+
+        def he(k, shape):
+            fan_in = shape[0] * shape[1] * shape[2]
+            return jax.random.normal(k, shape, dtype) * math.sqrt(2.0 / fan_in)
+
+        params = {
+            "conv1": he(ks[0], (1, 1, c_in, c_b)),
+            "conv2": he(ks[1], (3, 3, c_b, c_b)),
+            "conv3": he(ks[2], (1, 1, c_b, c_out)),
+        }
+        for i in (1, 2, 3):
+            params[f"scale{i}"] = jnp.ones((params[f"conv{i}"].shape[-1],), dtype)
+            params[f"bias{i}"] = jnp.zeros((params[f"conv{i}"].shape[-1],), dtype)
+        if self.has_downsample:
+            params["conv4"] = he(ks[3], (1, 1, c_in, c_out))
+            params["scale4"] = jnp.ones((c_out,), dtype)
+            params["bias4"] = jnp.zeros((c_out,), dtype)
+        return params
+
+    def apply(self, params, x):
+        s1 = self.stride if self.stride_1x1 else 1
+        s2 = 1 if self.stride_1x1 else self.stride
+        ax = self.axis_name
+        # 1x1 convs and the affine/relu epilogues are purely local in H
+        out = lax.conv_general_dilated(
+            x, params["conv1"], (s1, s1), "SAME", dimension_numbers=_DIMNUMS)
+        out = _scale_bias_relu(out, params["scale1"], params["bias1"])
+        # only the 3x3 sees neighbor rows
+        out = spatial_conv2d(out, params["conv2"], stride=s2, axis_name=ax)
+        out = _scale_bias_relu(out, params["scale2"], params["bias2"])
+        out = lax.conv_general_dilated(
+            out, params["conv3"], (1, 1), "SAME", dimension_numbers=_DIMNUMS)
+        out = _scale_bias_relu(out, params["scale3"], params["bias3"], relu=False)
+        if self.has_downsample:
+            resid = lax.conv_general_dilated(
+                x, params["conv4"], (self.stride, self.stride), "SAME",
+                dimension_numbers=_DIMNUMS)
+            resid = _scale_bias_relu(resid, params["scale4"], params["bias4"],
+                                     relu=False)
+        else:
+            resid = x
+        return jax.nn.relu(out + resid)
+
+    __call__ = apply
+
+
+class Bottleneck(SpatialBottleneck):
+    """Unsharded block (reference apex/contrib/bottleneck/bottleneck.py:64)."""
+
+    def __init__(self, in_channels, bottleneck_channels, out_channels,
+                 stride=1, stride_1x1: bool = False):
+        super().__init__(in_channels, bottleneck_channels, out_channels,
+                         stride=stride, stride_1x1=stride_1x1, axis_name=None)

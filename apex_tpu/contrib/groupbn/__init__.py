@@ -1,0 +1,5 @@
+"""GroupBN: NHWC batch norm with group stats (reference apex/contrib/groupbn/)."""
+
+from apex_tpu.contrib.groupbn.batch_norm import BatchNorm2d_NHWC
+
+__all__ = ["BatchNorm2d_NHWC"]

@@ -28,6 +28,7 @@ to exactly 0.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
 import jax
@@ -89,14 +90,25 @@ def transducer_joint(
     return _pack(h, f_len, g_len, batch_offset, packed_batch, valid)
 
 
-def _pack(h, f_len, g_len, batch_offset, packed_batch: int, valid):
-    """Scatter the valid (b,t,u) cells of ``h`` into a compact
-    (packed_batch, H) buffer: dest = batch_offset[b-1] + t*g_len[b] + u."""
-    B, T, U, H = h.shape
+def _cell_index(f_len, g_len, batch_offset, T: int, U: int):
+    """The packed-cell addressing contract, in one place:
+    ``idx[b,t,u] = batch_offset[b-1] + t*g_len[b] + u`` with validity mask
+    ``(t < f_len[b]) & (u < g_len[b])``. Returns ``(idx, valid)``."""
     start = batch_offset - f_len * g_len  # offset of batch b's first cell
     t_idx = jnp.arange(T)[None, :, None]
     u_idx = jnp.arange(U)[None, None, :]
-    dest = start[:, None, None] + t_idx * g_len[:, None, None] + u_idx
+    idx = start[:, None, None] + t_idx * g_len[:, None, None] + u_idx
+    valid = (t_idx < f_len[:, None, None]) & (u_idx < g_len[:, None, None])
+    return idx, valid
+
+
+def _pack(h, f_len, g_len, batch_offset, packed_batch: int, valid=None):
+    """Scatter the valid (b,t,u) cells of ``h`` into a compact
+    (packed_batch, H) buffer."""
+    B, T, U, H = h.shape
+    dest, v = _cell_index(f_len, g_len, batch_offset, T, U)
+    if valid is None:
+        valid = v
     # invalid cells scatter out of bounds and are dropped
     dest = jnp.where(valid, dest, packed_batch)
     out = jnp.zeros((packed_batch, H), h.dtype)
@@ -106,11 +118,7 @@ def _pack(h, f_len, g_len, batch_offset, packed_batch: int, valid):
 def _unpack(x_packed, f_len, g_len, batch_offset, B: int, T: int, U: int):
     """Inverse of :func:`_pack` (gather); used to adapt packed loss inputs
     to the dense lattice layout the DP wants."""
-    start = batch_offset - f_len * g_len
-    t_idx = jnp.arange(T)[None, :, None]
-    u_idx = jnp.arange(U)[None, None, :]
-    src = start[:, None, None] + t_idx * g_len[:, None, None] + u_idx
-    valid = (t_idx < f_len[:, None, None]) & (u_idx < g_len[:, None, None])
+    src, valid = _cell_index(f_len, g_len, batch_offset, T, U)
     src = jnp.where(valid, src, 0)
     out = x_packed[src.reshape(-1)].reshape(B, T, U, x_packed.shape[-1])
     return jnp.where(valid[..., None], out, 0.0)
@@ -214,10 +222,12 @@ def _lattice_terms(x_log, label, blank_idx):
     return blank, emit
 
 
-def _alpha_beta(x_log, label, f_len, y_len, blank_idx):
+def _alpha_beta(x_log, label, f_len, y_len, blank_idx, need_alpha=True):
     """Both DPs (reference forward_alpha/forward_beta in
     contrib/test/transducer/transducer_ref.py are the spec; the CUDA
-    kernels in contrib/csrc/transducer compute the same lattice)."""
+    kernels in contrib/csrc/transducer compute the same lattice).
+    ``need_alpha=False`` skips the alpha scan (the primal only needs beta;
+    under jit XLA would DCE it anyway, but eager callers shouldn't pay)."""
     B, T, U, V = x_log.shape
     blank, emit = _lattice_terms(x_log, label, blank_idx)
     t_ax = jnp.arange(T)[None, :, None]
@@ -225,9 +235,11 @@ def _alpha_beta(x_log, label, f_len, y_len, blank_idx):
 
     # ----- alpha: transitions INTO (t,u) read the source cell -----
     # vertical (t-1,u)->(t,u) weight blank[t-1,u]; horizontal emit[t,u-1]
-    Va = jnp.concatenate([jnp.full((B, 1, U), _NEG_INF), blank[:, :-1]], axis=1)
-    Ha = jnp.concatenate([jnp.full((B, T, 1), _NEG_INF), emit[:, :, :-1]], axis=2)
-    alpha = _wavefront(Va, Ha, jnp.zeros((B,)))
+    alpha = None
+    if need_alpha:
+        Va = jnp.concatenate([jnp.full((B, 1, U), _NEG_INF), blank[:, :-1]], axis=1)
+        Ha = jnp.concatenate([jnp.full((B, T, 1), _NEG_INF), emit[:, :, :-1]], axis=2)
+        alpha = _wavefront(Va, Ha, jnp.zeros((B,)))
 
     # ----- beta: reverse per-batch around (f_len-1, y_len) -----
     # beta'[t',u'] = beta[f_len-1-t', y_len-u'] turns the backward DP into
@@ -248,13 +260,10 @@ def _alpha_beta(x_log, label, f_len, y_len, blank_idx):
     return alpha, beta
 
 
-from functools import partial
-
-
 @partial(jax.custom_vjp, nondiff_argnums=(4,))
 def _loss_from_logits(x, label, f_len, y_len, blank_idx):
     y = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
-    _, beta = _alpha_beta(y, label, f_len, y_len, blank_idx)
+    _, beta = _alpha_beta(y, label, f_len, y_len, blank_idx, need_alpha=False)
     return -beta[:, 0, 0]
 
 

@@ -1,0 +1,93 @@
+"""GroupBN tests: group statistics over a mesh sub-axis, fused add+relu,
+running-stat updates — the checks the reference's distributed bn-group tests
+do on real GPUs (tests/distributed/synced_batchnorm/, bn_group variants)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+
+C = 6
+
+
+def _ref_bn(x, w, b, eps=1e-5):
+    m = x.astype(np.float64).mean(axis=(0, 1, 2))
+    v = x.astype(np.float64).var(axis=(0, 1, 2))
+    return ((x - m) / np.sqrt(v + eps) * w + b).astype(np.float32)
+
+
+def test_matches_reference_bn_single():
+    bn = BatchNorm2d_NHWC(C)
+    v = bn.init()
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 5, 3, C))
+    y, new_v = bn.apply(v, x)
+    want = _ref_bn(np.asarray(x), np.ones(C), np.zeros(C))
+    np.testing.assert_allclose(np.asarray(y), want, rtol=1e-4, atol=1e-5)
+    # minibatch buffers updated (reference batch_norm.py:110-111)
+    assert float(jnp.abs(new_v["state"]["minibatch_mean"]).sum()) > 0
+
+
+def test_bn_group_stats_match_pooled_batch():
+    """bn_group=4 over a mesh sub-axis == one BN over the pooled batch (the
+    IPC peer-stat path of the reference, batch_norm.py:120-160)."""
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4), ("data_outer", "data_bn"))
+    bn = BatchNorm2d_NHWC(C, bn_group=4, axis_name="data_bn")
+    v = bn.init()
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 4, 3, C))
+
+    def f(xl):
+        y, _ = bn.apply(v, xl)
+        return y
+
+    got = shard_map(f, mesh=mesh, in_specs=P(("data_outer", "data_bn")),
+                    out_specs=P(("data_outer", "data_bn")))(x)
+    # each outer group of 4 shards (= 8 rows of the batch) pools its stats
+    got = np.asarray(got)
+    for half in (slice(0, 8), slice(8, 16)):
+        want = _ref_bn(np.asarray(x[half]), np.ones(C), np.zeros(C))
+        np.testing.assert_allclose(got[half], want, rtol=1e-4, atol=1e-4)
+    # outer groups must NOT share stats: full-batch BN differs
+    full = _ref_bn(np.asarray(x), np.ones(C), np.zeros(C))
+    assert not np.allclose(got, full, atol=1e-4)
+
+
+def test_addrelu_and_grads():
+    bn = BatchNorm2d_NHWC(C)
+    v = bn.init()
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 3, 3, C))
+    z = jax.random.normal(jax.random.PRNGKey(3), (4, 3, 3, C))
+
+    y, _ = bn.apply(v, x, z)
+    assert float(y.min()) >= 0.0
+    # dz flows only through the relu mask (reference bitmask backward,
+    # batch_norm.py:78-99 — AD re-derives the mask)
+    def s(z):
+        out, _ = bn.apply(v, x, z)
+        return jnp.sum(out)
+    dz = jax.grad(s)(z)
+    mask = np.asarray(y) > 0
+    np.testing.assert_array_equal(np.asarray(dz) != 0, mask)
+
+
+def test_eval_uses_running_stats():
+    bn = BatchNorm2d_NHWC(C, momentum=1.0)  # running stats := batch stats
+    v = bn.init()
+    x = jax.random.normal(jax.random.PRNGKey(4), (8, 4, 4, C)) * 3 + 1
+    _, v2 = bn.apply(v, x, training=True)
+    y_eval, _ = bn.apply(v2, x, training=False)
+    # eval with momentum=1 running stats ~ train normalize (up to the
+    # unbiased-var correction)
+    n = x.size // C
+    corr = np.sqrt(n / (n - 1))  # sqrt(var_unbiased / var_biased)
+    y_train, _ = bn.apply(v, x, training=True)
+    np.testing.assert_allclose(np.asarray(y_eval) * corr, np.asarray(y_train),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_bn_group_requires_axis():
+    with pytest.raises(ValueError):
+        BatchNorm2d_NHWC(C, bn_group=2)
