@@ -1,0 +1,50 @@
+"""amp ↔ RNN integration (reference apex/amp/rnn_compat.py + compat.py).
+
+The reference makes torch's cuDNN RNN cells patchable by O1 by routing them
+through a ``VariableFunctionsShim`` and whitelisting the cell functions
+(``whitelist_rnn_cells``, rnn_compat.py). Here the O1 policy is explicit
+wrappers (see :mod:`apex_tpu.amp.lists`), so the RNN analog is:
+
+- cell names registered in ``FP16_FUNCS`` — the cells are gate-GEMM bound,
+  exactly the MXU-friendly class the whitelist exists for;
+- :func:`half_cell` to wrap any ``cell(params, x, hidden)`` so inputs,
+  hidden state, and params run in the half dtype with fp32 carry of the
+  cell state ``c`` (the fp32-state discipline ``rnn_compat``'s fused cells
+  get from their fp32 accumulators).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import lists
+
+RNN_CELL_NAMES = ["lstm_cell", "gru_cell", "rnn_relu_cell", "rnn_tanh_cell",
+                  "mlstm_cell"]
+
+
+def whitelist_rnn_cells():
+    """Register the RNN cells in the O1 whitelist (reference
+    ``whitelist_rnn_cells``, rnn_compat.py:25-53). Idempotent."""
+    for name in RNN_CELL_NAMES:
+        if name not in lists.FP16_FUNCS:
+            lists.FP16_FUNCS.append(name)
+
+
+def half_cell(cell, half_dtype=jnp.bfloat16):
+    """Wrap an ``apex_tpu.rnn.cells`` cell for O1: compute in half, keep the
+    cell state (hidden[1:], e.g. LSTM ``c``) in fp32."""
+
+    def wrapped(params, x, hidden):
+        cast = lambda t: jax.tree_util.tree_map(
+            lambda a: a.astype(half_dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, t)
+        h_half = (cast(hidden[0]),) + tuple(h.astype(jnp.float32) for h in hidden[1:])
+        out = cell(cast(params), cast(x), h_half)
+        # fp32 cell state promotes the pointwise epilogue; pin the output
+        # hidden back to half and the state to fp32
+        return (out[0].astype(half_dtype),) + tuple(
+            h.astype(jnp.float32) for h in out[1:])
+
+    return wrapped

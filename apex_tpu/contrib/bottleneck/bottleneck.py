@@ -154,6 +154,14 @@ class SpatialBottleneck:
         s1 = self.stride if self.stride_1x1 else 1
         s2 = 1 if self.stride_1x1 else self.stride
         ax = self.axis_name
+        if ax is not None and self.stride > 1 and x.shape[1] % self.stride:
+            # a shard-local strided conv only equals the global one when each
+            # shard keeps the global stride phase (1x1 SAME stride-s reads
+            # rows s*o, so the shard's first row must sit at an s-aligned
+            # global offset — guaranteed iff H_local % s == 0)
+            raise ValueError(
+                f"local H ({x.shape[1]}) must be divisible by stride "
+                f"({self.stride}) under spatial sharding")
         # 1x1 convs and the affine/relu epilogues are purely local in H
         out = lax.conv_general_dilated(
             x, params["conv1"], (s1, s1), "SAME", dimension_numbers=_DIMNUMS)

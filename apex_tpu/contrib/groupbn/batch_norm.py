@@ -32,7 +32,11 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.parallel.sync_batchnorm import AxisName, sync_batch_norm_stats
+from apex_tpu.parallel.sync_batchnorm import (
+    AxisName,
+    sync_batch_norm_stats,
+    update_running_stats,
+)
 
 
 class BatchNorm2d_NHWC:
@@ -90,12 +94,12 @@ class BatchNorm2d_NHWC:
         if training:
             mean, var, n = sync_batch_norm_stats(x, self.axis_name, channel_axis=-1)
             invstd = jax.lax.rsqrt(var + self.eps)
-            unbiased = var * (n / jnp.maximum(n - 1.0, 1.0))
+            rm, rv = update_running_stats(
+                state["running_mean"], state["running_var"], mean, var, n,
+                self.momentum)
             new_state = {
-                "running_mean": (1 - self.momentum) * state["running_mean"]
-                + self.momentum * mean,
-                "running_var": (1 - self.momentum) * state["running_var"]
-                + self.momentum * unbiased,
+                "running_mean": rm,
+                "running_var": rv,
                 "minibatch_mean": mean,
                 "minibatch_riv": invstd,
             }

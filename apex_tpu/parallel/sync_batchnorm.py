@@ -69,6 +69,17 @@ def sync_batch_norm_stats(
     return mean, var, local_n
 
 
+def update_running_stats(running_mean, running_var, mean, var, n, momentum):
+    """EMA of running stats with the unbiased n/(n-1) variance correction
+    (reference kernel.py:48-56). Shared by SyncBN and GroupBN so the
+    convention lives in one place."""
+    unbiased = var * (n / jnp.maximum(n - 1.0, 1.0))
+    return (
+        (1 - momentum) * running_mean + momentum * mean,
+        (1 - momentum) * running_var + momentum * unbiased,
+    )
+
+
 def sync_batch_norm(
     x: jnp.ndarray,
     weight: Optional[jnp.ndarray],
@@ -90,10 +101,8 @@ def sync_batch_norm(
     if training:
         mean, var, n = sync_batch_norm_stats(x, axis_name, channel_axis)
         if running_mean is not None:
-            # unbiased var for running stats — kernel.py:48-56
-            unbiased = var * (n / jnp.maximum(n - 1.0, 1.0))
-            new_rm = (1 - momentum) * running_mean + momentum * mean
-            new_rv = (1 - momentum) * running_var + momentum * unbiased
+            new_rm, new_rv = update_running_stats(
+                running_mean, running_var, mean, var, n, momentum)
         else:
             new_rm, new_rv = None, None
     elif running_mean is not None:

@@ -1,0 +1,15 @@
+"""Weight reparameterizations (reference apex/reparameterization/)."""
+
+from apex_tpu.reparameterization.weight_norm import (
+    apply_weight_norm,
+    compute_weights,
+    remove_weight_norm,
+    weight_norm,
+)
+
+__all__ = [
+    "apply_weight_norm",
+    "compute_weights",
+    "remove_weight_norm",
+    "weight_norm",
+]

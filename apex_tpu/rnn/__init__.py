@@ -1,0 +1,6 @@
+"""RNN stack (reference apex/RNN/): LSTM/GRU/ReLU/Tanh/mLSTM over lax.scan."""
+
+from apex_tpu.rnn.models import GRU, LSTM, RNN, ReLU, Tanh, mLSTM
+from apex_tpu.rnn import cells
+
+__all__ = ["RNN", "LSTM", "GRU", "ReLU", "Tanh", "mLSTM", "cells"]

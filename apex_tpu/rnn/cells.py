@@ -1,0 +1,79 @@
+"""RNN cells as pure functions (reference apex/RNN/cells.py + the torch
+cell functions apex/RNN/models.py imports).
+
+Each cell is ``cell(params, x, hidden) -> new_hidden`` with ``hidden`` a
+tuple of ``n_hidden_states`` arrays and ``new_hidden[0]`` the output — the
+contract the reference backend assumes (RNNBackend.py:87 "assumes
+hidden_state[0] ... is output hidden state").
+
+The reference fuses the gate pointwise math via ``rnnFusedPointwise``
+(cells.py:64-66); XLA fuses the same expressions automatically, and the two
+gate GEMMs per step stay on the MXU. Gate parameter layout matches torch:
+``w_ih (gate_multiplier*hidden, input)``, gates ordered i, f, g, o for LSTM
+and r, z, n for GRU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _linear(x, w, b=None):
+    y = x @ w.T
+    return y + b if b is not None else y
+
+
+def lstm_cell(params, x, hidden):
+    """torch ``LSTMCell`` parity; hidden = (h, c)."""
+    hx, cx = hidden
+    gates = _linear(x, params["w_ih"], params.get("b_ih")) + _linear(
+        hx, params["w_hh"], params.get("b_hh"))
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    cy = f * cx + i * g
+    hy = o * jnp.tanh(cy)
+    return (hy, cy)
+
+
+def mlstm_cell(params, x, hidden):
+    """Multiplicative LSTM (reference cells.py:56-84): an elementwise
+    product of input/hidden projections modulates the hidden gates."""
+    hx, cx = hidden
+    m = _linear(x, params["w_mih"]) * _linear(hx, params["w_mhh"])
+    gates = _linear(x, params["w_ih"], params.get("b_ih")) + _linear(
+        m, params["w_hh"], params.get("b_hh"))
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    cy = f * cx + i * g
+    hy = o * jnp.tanh(cy)
+    return (hy, cy)
+
+
+def gru_cell(params, x, hidden):
+    """torch ``GRUCell`` parity; hidden = (h,)."""
+    (hx,) = hidden
+    gi = _linear(x, params["w_ih"], params.get("b_ih"))
+    gh = _linear(hx, params["w_hh"], params.get("b_hh"))
+    i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+    h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(i_r + h_r)
+    z = jax.nn.sigmoid(i_z + h_z)
+    n = jnp.tanh(i_n + r * h_n)
+    return ((1.0 - z) * n + z * hx,)
+
+
+def rnn_relu_cell(params, x, hidden):
+    (hx,) = hidden
+    return (jax.nn.relu(
+        _linear(x, params["w_ih"], params.get("b_ih"))
+        + _linear(hx, params["w_hh"], params.get("b_hh"))),)
+
+
+def rnn_tanh_cell(params, x, hidden):
+    (hx,) = hidden
+    return (jnp.tanh(
+        _linear(x, params["w_ih"], params.get("b_ih"))
+        + _linear(hx, params["w_hh"], params.get("b_hh"))),)

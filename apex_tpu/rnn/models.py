@@ -1,0 +1,195 @@
+"""Stacked / bidirectional RNNs over scan (reference apex/RNN/).
+
+Re-design of ``stackedRNN`` / ``bidirectionalRNN`` / ``RNNCell``
+(apex/RNN/RNNBackend.py:25-365) and the model factories
+(apex/RNN/models.py:19-54: LSTM, GRU, ReLU, Tanh, mLSTM): the reference
+iterates timesteps in Python holding mutable per-module hidden state; here
+the time loop is one ``lax.scan`` per layer (static trip count, MXU-friendly
+batched GEMMs per step) and hidden state is explicit — passed in, returned
+out.
+
+Layout: seq-major ``(T, B, F)`` like the reference backend (it "always
+assumes batch_first" is false for input — RNNBackend.py:119 returns
+``[sequence steps][batch size][features]``); ``batch_first=True`` transposes
+at the boundary. ``output_size`` adds the reference's ``w_ho`` projection
+(RNNBackend.py RNNCell). Inter-layer dropout matches torch semantics (not
+applied after the last layer).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.rnn import cells as _cells
+
+
+class RNN:
+    """A stack of scanned RNN layers sharing one cell function.
+
+    ``init(key)`` returns the param pytree (list of per-layer dicts);
+    ``apply(params, x, hidden=None, key=None)`` returns
+    ``(output, last_hidden)`` with ``last_hidden`` a tuple of
+    ``n_hidden_states`` arrays shaped (num_layers*num_directions, B, H) —
+    the torch/reference convention.
+    """
+
+    def __init__(
+        self,
+        cell: Callable,
+        gate_multiplier: int,
+        n_hidden_states: int,
+        input_size: int,
+        hidden_size: int,
+        num_layers: int,
+        bias: bool = True,
+        batch_first: bool = False,
+        dropout: float = 0.0,
+        bidirectional: bool = False,
+        output_size: Optional[int] = None,
+        multiplicative: bool = False,
+    ):
+        self.cell = cell
+        self.gate_multiplier = gate_multiplier
+        self.n_hidden_states = n_hidden_states
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.bias = bias
+        self.batch_first = batch_first
+        self.dropout = dropout
+        self.bidirectional = bidirectional
+        self.output_size = output_size if output_size is not None else hidden_size
+        self.multiplicative = multiplicative
+        self.num_directions = 2 if bidirectional else 1
+
+    # -- params ----------------------------------------------------------
+    def _init_layer(self, key, in_size, dtype):
+        h, g, out = self.hidden_size, self.gate_multiplier, self.output_size
+        # torch RNN init: U(-1/sqrt(h), 1/sqrt(h)) (reference
+        # reset_parameters, RNNBackend.py)
+        bound = 1.0 / math.sqrt(h)
+        ks = jax.random.split(key, 7)
+        uni = lambda k, shape: jax.random.uniform(k, shape, dtype, -bound, bound)
+        p = {"w_ih": uni(ks[0], (g * h, in_size)), "w_hh": uni(ks[1], (g * h, out))}
+        if self.bias:
+            p["b_ih"] = uni(ks[2], (g * h,))
+            p["b_hh"] = uni(ks[3], (g * h,))
+        if self.multiplicative:
+            p["w_mih"] = uni(ks[4], (out, in_size))
+            p["w_mhh"] = uni(ks[5], (out, out))
+        if self.output_size != self.hidden_size:
+            p["w_ho"] = uni(ks[6], (out, h))
+        return p
+
+    def init(self, key, dtype=jnp.float32):
+        layers = []
+        for d in range(self.num_directions):
+            in_size = self.input_size
+            for i in range(self.num_layers):
+                key, sub = jax.random.split(key)
+                layers.append(self._init_layer(sub, in_size, dtype))
+                in_size = self.output_size
+        return layers
+
+    # -- forward ---------------------------------------------------------
+    def _zero_hidden(self, bsz, dtype):
+        shape = (bsz, self.output_size)
+        return tuple(
+            jnp.zeros(shape if i == 0 else (bsz, self.hidden_size), dtype)
+            for i in range(self.n_hidden_states)
+        )
+
+    def _run_layer(self, p, x, h0, reverse):
+        def step(h, xt):
+            new_h = self.cell(p, xt, h)
+            out = new_h[0]
+            if "w_ho" in p:
+                out = out @ p["w_ho"].T
+                new_h = (out,) + new_h[1:]
+            return new_h, out
+
+        h_last, out = jax.lax.scan(step, h0, x, reverse=reverse)
+        return out, h_last
+
+    def apply(self, params, x, hidden=None, *, key=None, training=True):
+        if self.batch_first:
+            x = jnp.swapaxes(x, 0, 1)
+        T, B = x.shape[0], x.shape[1]
+        n_total = self.num_layers * self.num_directions
+        if hidden is None:
+            per_layer = [self._zero_hidden(B, x.dtype) for _ in range(n_total)]
+        else:
+            per_layer = [tuple(s[i] for s in hidden) for i in range(n_total)]
+
+        def run_stack(layer_params, hiddens, reverse):
+            y = x
+            lasts = []
+            for li, (p, h0) in enumerate(zip(layer_params, hiddens)):
+                y, h_last = self._run_layer(p, y, h0, reverse)
+                lasts.append(h_last)
+                if self.dropout and training and li < len(layer_params) - 1:
+                    if key is None:
+                        raise ValueError("dropout requires key")
+                    keep = jax.random.bernoulli(
+                        jax.random.fold_in(key, li + (1000 if reverse else 0)),
+                        1.0 - self.dropout, y.shape)
+                    y = jnp.where(keep, y / (1.0 - self.dropout), 0.0)
+            return y, lasts
+
+        L = self.num_layers
+        fwd_out, fwd_lasts = run_stack(params[:L], per_layer[:L], reverse=False)
+        if self.bidirectional:
+            bwd_out, bwd_lasts = run_stack(params[L:], per_layer[L:], reverse=True)
+            out = jnp.concatenate([fwd_out, bwd_out], axis=-1)
+            lasts = fwd_lasts + bwd_lasts
+        else:
+            out, lasts = fwd_out, fwd_lasts
+        # stack per-layer hidden tuples -> tuple of (n_total, B, H)
+        hidden_out = tuple(
+            jnp.stack([l[i] for l in lasts]) for i in range(self.n_hidden_states)
+        )
+        if self.batch_first:
+            out = jnp.swapaxes(out, 0, 1)
+        return out, hidden_out
+
+    __call__ = apply
+
+
+def LSTM(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+         dropout=0.0, bidirectional=False, output_size=None):
+    """Reference apex/RNN/models.py:19."""
+    return RNN(_cells.lstm_cell, 4, 2, input_size, hidden_size, num_layers,
+               bias, batch_first, dropout, bidirectional, output_size)
+
+
+def GRU(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+        dropout=0.0, bidirectional=False, output_size=None):
+    """Reference apex/RNN/models.py:26."""
+    return RNN(_cells.gru_cell, 3, 1, input_size, hidden_size, num_layers,
+               bias, batch_first, dropout, bidirectional, output_size)
+
+
+def ReLU(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+         dropout=0.0, bidirectional=False, output_size=None):
+    """Reference apex/RNN/models.py:33."""
+    return RNN(_cells.rnn_relu_cell, 1, 1, input_size, hidden_size, num_layers,
+               bias, batch_first, dropout, bidirectional, output_size)
+
+
+def Tanh(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+         dropout=0.0, bidirectional=False, output_size=None):
+    """Reference apex/RNN/models.py:40."""
+    return RNN(_cells.rnn_tanh_cell, 1, 1, input_size, hidden_size, num_layers,
+               bias, batch_first, dropout, bidirectional, output_size)
+
+
+def mLSTM(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+          dropout=0.0, bidirectional=False, output_size=None):
+    """Reference apex/RNN/models.py:47 (cells.py mLSTMRNNCell)."""
+    return RNN(_cells.mlstm_cell, 4, 2, input_size, hidden_size, num_layers,
+               bias, batch_first, dropout, bidirectional, output_size,
+               multiplicative=True)

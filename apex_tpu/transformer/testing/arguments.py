@@ -1,0 +1,300 @@
+"""Megatron-style argument parsing (reference
+apex/transformer/testing/arguments.py:23-806), adapted to the TPU runtime.
+
+Same structure: grouped ``_add_*_args`` builders, ``parse_args`` with
+cross-argument consistency checks and world-size-derived defaults. TPU
+deltas, each deliberate:
+
+- world size comes from ``jax.device_count()`` (or --world-size for
+  emulated meshes), not RANK/WORLD_SIZE env (reference arguments.py:56-58);
+- ``--bf16`` is the native half type; ``--fp16`` keeps the reference
+  loss-scaling semantics for parity runs;
+- ``params_dtype`` is a jnp dtype; bf16 forces fp32 grad accumulation
+  exactly as the reference does (arguments.py:149-158);
+- DDP_impl/contiguous-buffer knobs are accepted but meaningless under XLA
+  (flagged in help) — kept so reference scripts parse unchanged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+
+def parse_args(extra_args_provider: Optional[Callable] = None, defaults: dict = {},
+               ignore_unknown_args: bool = False, args=None):
+    """Parse all arguments (reference arguments.py:23-280)."""
+    parser = argparse.ArgumentParser(description="apex_tpu Megatron Arguments",
+                                     allow_abbrev=False)
+    parser = _add_network_size_args(parser)
+    parser = _add_regularization_args(parser)
+    parser = _add_training_args(parser)
+    parser = _add_initialization_args(parser)
+    parser = _add_learning_rate_args(parser)
+    parser = _add_checkpointing_args(parser)
+    parser = _add_mixed_precision_args(parser)
+    parser = _add_distributed_args(parser)
+    parser = _add_validation_args(parser)
+    parser = _add_data_args(parser)
+    parser = _add_logging_args(parser)
+    if extra_args_provider is not None:
+        parser = extra_args_provider(parser)
+
+    if ignore_unknown_args:
+        parsed, _ = parser.parse_known_args(args)
+    else:
+        parsed = parser.parse_args(args)
+    return _validate_and_derive(parsed, defaults)
+
+
+def _validate_and_derive(args, defaults):
+    """The consistency-check block (reference arguments.py:55-280)."""
+    # world size: explicit flag (emulated mesh) > device count
+    if args.world_size is None:
+        try:
+            import jax
+
+            args.world_size = jax.device_count()
+        except Exception:
+            args.world_size = 1
+    args.rank = int(os.getenv("RANK", "0"))
+
+    args.tensor_model_parallel_size = min(
+        args.tensor_model_parallel_size, args.world_size)
+    assert args.world_size % args.tensor_model_parallel_size == 0, (
+        f"world size ({args.world_size}) is not divisible by tensor model "
+        f"parallel size ({args.tensor_model_parallel_size})")
+    args.pipeline_model_parallel_size = min(
+        args.pipeline_model_parallel_size,
+        args.world_size // args.tensor_model_parallel_size)
+    model_parallel_size = (
+        args.pipeline_model_parallel_size * args.tensor_model_parallel_size)
+    assert args.world_size % model_parallel_size == 0, (
+        f"world size ({args.world_size}) is not divisible by tensor parallel "
+        f"size ({args.tensor_model_parallel_size}) times pipeline parallel "
+        f"size ({args.pipeline_model_parallel_size})")
+    args.data_parallel_size = args.world_size // model_parallel_size
+
+    # user-supplied defaults only fill unset (None) args — reference :108-120
+    for key, val in defaults.items():
+        if getattr(args, key, None) is None:
+            setattr(args, key, val)
+
+    # batch sizes — reference :122-130
+    assert args.micro_batch_size is not None and args.micro_batch_size > 0
+    if args.global_batch_size is None:
+        args.global_batch_size = args.micro_batch_size * args.data_parallel_size
+    assert args.global_batch_size > 0
+    assert args.global_batch_size % (
+        args.micro_batch_size * args.data_parallel_size) == 0
+
+    # virtual pipeline — reference :131-141
+    if args.num_layers_per_virtual_pipeline_stage is not None:
+        assert args.pipeline_model_parallel_size > 2, (
+            "pipeline-model-parallel size should be greater than 2 with "
+            "interleaved schedule")
+        assert args.num_layers % args.num_layers_per_virtual_pipeline_stage == 0
+        args.virtual_pipeline_model_parallel_size = (
+            args.num_layers // args.pipeline_model_parallel_size
+        ) // args.num_layers_per_virtual_pipeline_stage
+    else:
+        args.virtual_pipeline_model_parallel_size = None
+
+    # params dtype — reference :145-163; TPU-native half is bf16
+    assert not (args.fp16 and args.bf16)
+    args.params_dtype = jnp.float32
+    if args.fp16:
+        args.params_dtype = jnp.float16
+    if args.bf16:
+        args.params_dtype = jnp.bfloat16
+        # bf16 grads accumulate/all-reduce in fp32 (reference :152-158)
+        args.accumulate_allreduce_grads_in_fp32 = True
+
+    if args.lr is not None and args.min_lr is not None:
+        assert args.min_lr <= args.lr
+    if args.lr_warmup_fraction is not None:
+        assert args.lr_warmup_iters == 0, (
+            "can only specify one of lr-warmup-fraction and lr-warmup-iters")
+    if args.save_interval is not None:
+        assert args.save is not None, "--save-interval needs --save"
+    for req in ("hidden_size", "num_attention_heads"):
+        assert getattr(args, req) is not None, f"--{req.replace('_', '-')} is required"
+    assert args.hidden_size % args.num_attention_heads == 0
+    if args.seq_length is not None and args.max_position_embeddings is not None:
+        assert args.max_position_embeddings >= args.seq_length
+    if args.fp32_residual_connection:
+        assert args.fp16 or args.bf16
+
+    args.consumed_train_samples = 0
+    args.consumed_valid_samples = 0
+    return args
+
+
+def _add_network_size_args(parser):
+    group = parser.add_argument_group(title="network size")
+    group.add_argument("--num-layers", type=int, default=None)
+    group.add_argument("--hidden-size", type=int, default=None)
+    group.add_argument("--ffn-hidden-size", type=int, default=None,
+                       help="defaults to 4*hidden-size")
+    group.add_argument("--num-attention-heads", type=int, default=None)
+    group.add_argument("--kv-channels", type=int, default=None)
+    group.add_argument("--max-position-embeddings", type=int, default=None)
+    group.add_argument("--make-vocab-size-divisible-by", type=int, default=128)
+    group.add_argument("--layernorm-epsilon", type=float, default=1e-5)
+    group.add_argument("--apply-residual-connection-post-layernorm",
+                       action="store_true")
+    group.add_argument("--openai-gelu", action="store_true")
+    group.add_argument("--onnx-safe", type=bool, default=None)
+    return parser
+
+
+def _add_regularization_args(parser):
+    group = parser.add_argument_group(title="regularization")
+    group.add_argument("--attention-dropout", type=float, default=0.1)
+    group.add_argument("--hidden-dropout", type=float, default=0.1)
+    group.add_argument("--weight-decay", type=float, default=0.01)
+    group.add_argument("--clip-grad", type=float, default=1.0)
+    group.add_argument("--adam-beta1", type=float, default=0.9)
+    group.add_argument("--adam-beta2", type=float, default=0.999)
+    group.add_argument("--adam-eps", type=float, default=1e-8)
+    group.add_argument("--sgd-momentum", type=float, default=0.9)
+    return parser
+
+
+def _add_training_args(parser):
+    group = parser.add_argument_group(title="training")
+    group.add_argument("--micro-batch-size", type=int, default=None)
+    group.add_argument("--global-batch-size", type=int, default=None)
+    group.add_argument("--rampup-batch-size", nargs="*", default=None,
+                       help="<start batch size> <increment> <ramp-up samples>")
+    group.add_argument("--train-iters", type=int, default=None)
+    group.add_argument("--train-samples", type=int, default=None)
+    group.add_argument("--log-interval", type=int, default=100)
+    group.add_argument("--exit-interval", type=int, default=None)
+    group.add_argument("--tensorboard-dir", type=str, default=None)
+    group.add_argument("--activations-checkpoint-method", type=str,
+                       choices=["uniform", "block"], default=None)
+    group.add_argument("--activations-checkpoint-num-layers", type=int, default=1)
+    group.add_argument("--distribute-checkpointed-activations",
+                       action="store_true")
+    group.add_argument("--optimizer", type=str, default="adam",
+                       choices=["adam", "sgd", "lamb", "novograd", "adagrad"])
+    group.add_argument("--dataloader-type", type=str, default="single",
+                       choices=["single", "cyclic"])
+    return parser
+
+
+def _add_initialization_args(parser):
+    group = parser.add_argument_group(title="initialization")
+    group.add_argument("--seed", type=int, default=1234)
+    group.add_argument("--init-method-std", type=float, default=0.02)
+    group.add_argument("--init-method-xavier-uniform", action="store_true")
+    return parser
+
+
+def _add_learning_rate_args(parser):
+    group = parser.add_argument_group(title="learning rate")
+    group.add_argument("--lr", type=float, default=None)
+    group.add_argument("--lr-decay-style", type=str, default="linear",
+                       choices=["constant", "linear", "cosine"])
+    group.add_argument("--lr-decay-iters", type=int, default=None)
+    group.add_argument("--lr-decay-samples", type=int, default=None)
+    group.add_argument("--lr-warmup-fraction", type=float, default=None)
+    group.add_argument("--lr-warmup-iters", type=int, default=0)
+    group.add_argument("--lr-warmup-samples", type=int, default=0)
+    group.add_argument("--min-lr", type=float, default=0.0)
+    group.add_argument("--override-lr-scheduler", action="store_true")
+    group.add_argument("--use-checkpoint-lr-scheduler", action="store_true")
+    return parser
+
+
+def _add_checkpointing_args(parser):
+    group = parser.add_argument_group(title="checkpointing")
+    group.add_argument("--save", type=str, default=None)
+    group.add_argument("--save-interval", type=int, default=None)
+    group.add_argument("--no-save-optim", action="store_true", default=None)
+    group.add_argument("--no-save-rng", action="store_true", default=None)
+    group.add_argument("--load", type=str, default=None)
+    group.add_argument("--no-load-optim", action="store_true", default=None)
+    group.add_argument("--no-load-rng", action="store_true", default=None)
+    group.add_argument("--finetune", action="store_true")
+    return parser
+
+
+def _add_mixed_precision_args(parser):
+    group = parser.add_argument_group(title="mixed precision")
+    group.add_argument("--fp16", action="store_true",
+                       help="fp16 + loss scaling (reference parity mode)")
+    group.add_argument("--bf16", action="store_true",
+                       help="bfloat16 — the TPU-native half type")
+    group.add_argument("--loss-scale", type=float, default=None,
+                       help="static loss scale; None = dynamic")
+    group.add_argument("--initial-loss-scale", type=float, default=2 ** 16)
+    group.add_argument("--min-loss-scale", type=float, default=1.0)
+    group.add_argument("--loss-scale-window", type=float, default=2000)
+    group.add_argument("--hysteresis", type=int, default=2)
+    group.add_argument("--fp32-residual-connection", action="store_true")
+    group.add_argument("--accumulate-allreduce-grads-in-fp32",
+                       action="store_true")
+    group.add_argument("--attention-softmax-in-fp32", action="store_true")
+    return parser
+
+
+def _add_distributed_args(parser):
+    group = parser.add_argument_group(title="distributed")
+    group.add_argument("--tensor-model-parallel-size", type=int, default=1)
+    group.add_argument("--pipeline-model-parallel-size", type=int, default=1)
+    group.add_argument("--pipeline-model-parallel-split-rank", type=int,
+                       default=None)
+    group.add_argument("--num-layers-per-virtual-pipeline-stage", type=int,
+                       default=None)
+    group.add_argument("--world-size", type=int, default=None,
+                       help="override device count (emulated meshes)")
+    group.add_argument("--distributed-backend", default="xla",
+                       choices=["xla", "nccl", "gloo"],
+                       help="accepted for script parity; the mesh always "
+                            "rides XLA collectives")
+    group.add_argument("--DDP-impl", default="local",
+                       choices=["local", "torch"],
+                       help="no-op under XLA (GSPMD owns bucketing)")
+    group.add_argument("--use-contiguous-buffers-in-local-ddp",
+                       action="store_true", help="no-op under XLA")
+    group.add_argument("--local_rank", type=int, default=None)
+    return parser
+
+
+def _add_validation_args(parser):
+    group = parser.add_argument_group(title="validation")
+    group.add_argument("--eval-iters", type=int, default=100)
+    group.add_argument("--eval-interval", type=int, default=1000)
+    return parser
+
+
+def _add_data_args(parser):
+    group = parser.add_argument_group(title="data and dataloader")
+    group.add_argument("--data-path", nargs="*", default=None)
+    group.add_argument("--split", type=str, default="969, 30, 1")
+    group.add_argument("--vocab-file", type=str, default=None)
+    group.add_argument("--merge-file", type=str, default=None)
+    group.add_argument("--seq-length", type=int, default=None)
+    group.add_argument("--encoder-seq-length", type=int, default=None)
+    group.add_argument("--decoder-seq-length", type=int, default=None)
+    group.add_argument("--num-workers", type=int, default=2)
+    group.add_argument("--reset-position-ids", action="store_true")
+    group.add_argument("--reset-attention-mask", action="store_true")
+    group.add_argument("--eod-mask-loss", action="store_true")
+    return parser
+
+
+def _add_logging_args(parser):
+    group = parser.add_argument_group(title="logging")
+    group.add_argument("--log-params-norm", action="store_true")
+    group.add_argument("--log-num-zeros-in-grad", action="store_true")
+    group.add_argument("--timing-log-level", type=int, default=0,
+                       choices=range(0, 3))
+    group.add_argument("--log-timers-to-tensorboard", action="store_true")
+    group.add_argument("--log-memory-to-tensorboard", action="store_true")
+    return parser

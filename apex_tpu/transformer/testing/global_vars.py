@@ -1,0 +1,113 @@
+"""Global args/timers/microbatch-calculator singletons (reference
+apex/transformer/testing/global_vars.py:34-270).
+
+Same contract: ``set_global_variables`` parses args exactly once and builds
+the microbatch calculator + timers; getters assert initialization. The
+tensorboard writer hook keeps the reference's graceful degradation (None
+when the package or --tensorboard-dir is absent).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from apex_tpu.transformer.pipeline_parallel._timers import Timers
+from apex_tpu.transformer.pipeline_parallel.microbatches import (
+    build_num_microbatches_calculator,
+)
+from apex_tpu.transformer.testing import arguments
+
+_GLOBAL_ARGS = None
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+_GLOBAL_TENSORBOARD_WRITER = None
+_GLOBAL_TIMERS = None
+
+
+def _ensure_initialized(var, name):
+    assert var is not None, f"{name} is not initialized."
+
+
+def _ensure_not_initialized(var, name):
+    assert var is None, f"{name} is already initialized."
+
+
+def get_args():
+    """Reference global_vars.py:34-37."""
+    _ensure_initialized(_GLOBAL_ARGS, "args")
+    return _GLOBAL_ARGS
+
+
+def get_num_microbatches() -> int:
+    _ensure_initialized(_GLOBAL_NUM_MICROBATCHES_CALCULATOR,
+                        "num microbatches calculator")
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get()
+
+
+def get_current_global_batch_size() -> int:
+    _ensure_initialized(_GLOBAL_NUM_MICROBATCHES_CALCULATOR,
+                        "num microbatches calculator")
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get_current_global_batch_size()
+
+
+def update_num_microbatches(consumed_samples: int, *,
+                            consistency_check: bool = True) -> None:
+    """Reference global_vars.py:46-58 (no-op unless rampup configured)."""
+    _ensure_initialized(_GLOBAL_NUM_MICROBATCHES_CALCULATOR,
+                        "num microbatches calculator")
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR.update(consumed_samples, consistency_check)
+
+
+def get_tensorboard_writer():
+    """May be None (reference global_vars.py:66-69)."""
+    return _GLOBAL_TENSORBOARD_WRITER
+
+
+def get_timers() -> Timers:
+    _ensure_initialized(_GLOBAL_TIMERS, "timers")
+    return _GLOBAL_TIMERS
+
+
+def set_global_variables(extra_args_provider=None, args_defaults={},
+                         ignore_unknown_args=False, args=None):
+    """Reference global_vars.py:87-101."""
+    global _GLOBAL_ARGS, _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    global _GLOBAL_TENSORBOARD_WRITER, _GLOBAL_TIMERS
+    _ensure_not_initialized(_GLOBAL_ARGS, "args")
+    _GLOBAL_ARGS = arguments.parse_args(
+        extra_args_provider=extra_args_provider, defaults=args_defaults,
+        ignore_unknown_args=ignore_unknown_args, args=args)
+
+    _ensure_not_initialized(_GLOBAL_NUM_MICROBATCHES_CALCULATOR,
+                            "num microbatches calculator")
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank=_GLOBAL_ARGS.rank,
+        rampup_batch_size=_GLOBAL_ARGS.rampup_batch_size,
+        global_batch_size=_GLOBAL_ARGS.global_batch_size,
+        micro_batch_size=_GLOBAL_ARGS.micro_batch_size,
+        data_parallel_size=_GLOBAL_ARGS.data_parallel_size,
+    )
+
+    if (_GLOBAL_TENSORBOARD_WRITER is None
+            and getattr(_GLOBAL_ARGS, "tensorboard_dir", None)):
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            _GLOBAL_TENSORBOARD_WRITER = SummaryWriter(
+                log_dir=_GLOBAL_ARGS.tensorboard_dir)
+        except Exception:
+            _GLOBAL_TENSORBOARD_WRITER = None
+
+    _ensure_not_initialized(_GLOBAL_TIMERS, "timers")
+    _GLOBAL_TIMERS = Timers()
+    return _GLOBAL_ARGS
+
+
+def destroy_global_vars():
+    """Test helper: reset all singletons (the reference leaks them between
+    unittest runs; explicit teardown is cleaner)."""
+    global _GLOBAL_ARGS, _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    global _GLOBAL_TENSORBOARD_WRITER, _GLOBAL_TIMERS
+    _GLOBAL_ARGS = None
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+    _GLOBAL_TENSORBOARD_WRITER = None
+    _GLOBAL_TIMERS = None

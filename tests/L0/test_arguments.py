@@ -1,0 +1,75 @@
+"""Megatron arguments + global_vars tests (reference
+apex/transformer/testing/arguments.py:23-280, global_vars.py:34-270)."""
+
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.transformer.testing import arguments, global_vars
+
+
+def _parse(argv, **kw):
+    return arguments.parse_args(args=argv, **kw)
+
+
+def test_parallel_size_derivation():
+    args = _parse(["--world-size", "16", "--micro-batch-size", "2",
+                   "--tensor-model-parallel-size", "4",
+                   "--pipeline-model-parallel-size", "2",
+                   "--num-attention-heads", "4", "--hidden-size", "64"])
+    assert args.data_parallel_size == 2
+    assert args.global_batch_size == 2 * 2  # micro * dp
+
+
+def test_world_size_divisibility_enforced():
+    with pytest.raises(AssertionError):
+        _parse(["--world-size", "6", "--micro-batch-size", "1",
+                "--tensor-model-parallel-size", "4",
+                "--num-attention-heads", "4", "--hidden-size", "64"])
+
+
+def test_virtual_pipeline_derivation():
+    args = _parse(["--world-size", "8", "--micro-batch-size", "1",
+                   "--pipeline-model-parallel-size", "4",
+                   "--num-layers", "16",
+                   "--num-layers-per-virtual-pipeline-stage", "2",
+                   "--num-attention-heads", "4", "--hidden-size", "64"])
+    # (16 layers / 4 stages) / 2 per chunk = 2 virtual chunks
+    assert args.virtual_pipeline_model_parallel_size == 2
+
+
+def test_bf16_forces_fp32_grad_accum():
+    args = _parse(["--world-size", "1", "--micro-batch-size", "1", "--bf16",
+                   "--num-attention-heads", "4", "--hidden-size", "64"])
+    assert args.params_dtype == jnp.bfloat16
+    assert args.accumulate_allreduce_grads_in_fp32
+    with pytest.raises(AssertionError):
+        _parse(["--world-size", "1", "--micro-batch-size", "1", "--bf16",
+                "--fp16", "--num-attention-heads", "4", "--hidden-size", "64"])
+
+
+def test_defaults_fill_only_unset():
+    args = _parse(["--world-size", "1", "--micro-batch-size", "1",
+                   "--num-attention-heads", "4", "--hidden-size", "64"],
+                  defaults={"seq_length": 512, "hidden_size": 9999})
+    assert args.seq_length == 512       # was None -> filled
+    assert args.hidden_size == 64       # explicitly set -> kept
+
+
+def test_global_vars_lifecycle():
+    global_vars.destroy_global_vars()
+    with pytest.raises(AssertionError):
+        global_vars.get_args()
+    global_vars.set_global_variables(args=[
+        "--world-size", "4", "--micro-batch-size", "2",
+        "--num-attention-heads", "4", "--hidden-size", "64"])
+    args = global_vars.get_args()
+    assert args.data_parallel_size == 4
+    assert global_vars.get_num_microbatches() == 1
+    assert global_vars.get_current_global_batch_size() == 8
+    timers = global_vars.get_timers()
+    timers("step").start()
+    timers("step").stop()
+    # double init asserts (reference _ensure_var_is_not_initialized)
+    with pytest.raises(AssertionError):
+        global_vars.set_global_variables(args=["--micro-batch-size", "1"])
+    global_vars.destroy_global_vars()
