@@ -46,6 +46,24 @@ def _is_wn_leafdict(x) -> bool:
     return isinstance(x, dict) and set(x.keys()) == {"g", "v"}
 
 
+def _check_dim(g, v, dim: Optional[int]):
+    """``g`` carries the dim implicitly (keepdims shape: size v.shape[dim]
+    at ``dim``, 1 elsewhere; scalar for dim=None). Validate the caller's
+    ``dim`` against it so apply/compute disagreement fails loudly instead
+    of broadcasting wrong."""
+    if g.ndim == 0:
+        if dim is not None:
+            raise ValueError("weight was normalized with dim=None; "
+                             f"compute_weights got dim={dim}")
+        return
+    want = tuple(v.shape[i] if i == dim % v.ndim else 1 for i in range(v.ndim))
+    if tuple(g.shape) != want:
+        raise ValueError(
+            f"g shape {tuple(g.shape)} does not match dim={dim} for weight "
+            f"shape {tuple(v.shape)} — apply_weight_norm and "
+            f"compute_weights must use the same dim")
+
+
 def apply_weight_norm(params, name: str = "", dim: int = 0,
                       predicate: Optional[Callable] = None):
     """Replace weight leaves with ``{"g", "v"}`` dicts.
@@ -82,6 +100,7 @@ def compute_weights(params, dim: int = 0):
 
     def _join(x):
         if _is_wn_leafdict(x):
+            _check_dim(x["g"], x["v"], dim)
             return weight_norm(x["v"], x["g"], dim)
         return x
 

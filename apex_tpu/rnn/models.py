@@ -33,8 +33,13 @@ class RNN:
     ``init(key)`` returns the param pytree (list of per-layer dicts);
     ``apply(params, x, hidden=None, key=None)`` returns
     ``(output, last_hidden)`` with ``last_hidden`` a tuple of
-    ``n_hidden_states`` arrays shaped (num_layers*num_directions, B, H) —
-    the torch/reference convention.
+    ``n_hidden_states`` arrays shaped (num_layers*num_directions, B, H).
+
+    Layer ordering is **direction-major** — all forward layers, then all
+    backward layers — mirroring the reference's two independent stacks
+    (bidirectionalRNN, RNNBackend.py:25-50). NOTE this differs from torch's
+    layer-major interleave (l0_fwd, l0_bwd, l1_fwd, ...); the two coincide
+    only for num_layers == 1.
     """
 
     def __init__(
@@ -65,6 +70,13 @@ class RNN:
         self.output_size = output_size if output_size is not None else hidden_size
         self.multiplicative = multiplicative
         self.num_directions = 2 if bidirectional else 1
+        if (self.output_size != self.hidden_size
+                and cell is _cells.gru_cell):
+            # GRU mixes hx elementwise with hidden_size-wide gates (z*hx),
+            # so a projected (output_size-wide) carry cannot feed it; LSTM/
+            # mLSTM/vanilla cells touch hx only through w_hh, which is
+            # shaped (g*h, output_size)
+            raise ValueError("GRU does not support output_size != hidden_size")
 
     # -- params ----------------------------------------------------------
     def _init_layer(self, key, in_size, dtype):

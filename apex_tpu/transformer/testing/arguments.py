@@ -123,6 +123,11 @@ def _validate_and_derive(args, defaults):
     for req in ("hidden_size", "num_attention_heads"):
         assert getattr(args, req) is not None, f"--{req.replace('_', '-')} is required"
     assert args.hidden_size % args.num_attention_heads == 0
+    # derived network sizes (reference arguments.py network-size defaults)
+    if args.ffn_hidden_size is None:
+        args.ffn_hidden_size = 4 * args.hidden_size
+    if args.kv_channels is None:
+        args.kv_channels = args.hidden_size // args.num_attention_heads
     if args.seq_length is not None and args.max_position_embeddings is not None:
         assert args.max_position_embeddings >= args.seq_length
     if args.fp32_residual_connection:

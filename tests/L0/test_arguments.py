@@ -55,6 +55,13 @@ def test_defaults_fill_only_unset():
     assert args.hidden_size == 64       # explicitly set -> kept
 
 
+def test_derived_network_sizes():
+    args = _parse(["--world-size", "1", "--micro-batch-size", "1",
+                   "--num-attention-heads", "4", "--hidden-size", "64"])
+    assert args.ffn_hidden_size == 256
+    assert args.kv_channels == 16
+
+
 def test_global_vars_lifecycle():
     global_vars.destroy_global_vars()
     with pytest.raises(AssertionError):

@@ -61,6 +61,17 @@ def test_name_filter_and_remove():
                                np.ones((3, 3)), rtol=1e-6)
 
 
+def test_dim_mismatch_raises():
+    import pytest
+    w = jax.random.normal(jax.random.PRNGKey(4), (4, 7))
+    p = apply_weight_norm({"w": w}, dim=1)
+    with pytest.raises(ValueError):
+        compute_weights(p, dim=0)
+    # matching dim works
+    back = compute_weights(p, dim=1)["w"]
+    np.testing.assert_allclose(np.asarray(back), np.asarray(w), rtol=1e-5)
+
+
 def test_gradients_decouple():
     """d/dg and d/dv are the decoupled directions weight norm exists for:
     grad wrt v is orthogonal to v (per output row)."""

@@ -142,6 +142,11 @@ def test_dropout_between_layers_only():
     np.testing.assert_array_equal(np.asarray(y_eval), np.asarray(y_eval2))
 
 
+def test_gru_rejects_output_size():
+    with pytest.raises(ValueError):
+        apex_rnn.GRU(I, H, 1, output_size=4)
+
+
 def test_rnn_compat_half_cell():
     whitelist_rnn_cells()
     assert "lstm_cell" in amp_lists.FP16_FUNCS

@@ -1,0 +1,185 @@
+"""L1 trajectory harness (reference tests/L1/common/).
+
+The reference L1 tier trains ResNet over the cross-product of
+opt-level × keep_batchnorm_fp32 × loss_scale × fused-optimizer
+(tests/L1/common/run_test.sh:29-60), dumps per-iteration loss, and asserts
+**bitwise-equal** trajectories between equivalent runs
+(tests/L1/common/compare.py:40-64). This harness provides the same
+instrument for the TPU build: ``run_trajectory(RunConfig)`` returns the
+per-step loss list for a tiny ResNet or GPT trained on deterministic
+synthetic data, single-device or data-parallel over the emulated mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import amp, optimizers
+from apex_tpu.models import ResNet, ResNetConfig
+from apex_tpu.ops import softmax_cross_entropy_loss
+from apex_tpu.transformer.testing.standalone_gpt import GPTConfig, GPTModel
+
+
+@dataclasses.dataclass(frozen=True)
+class RunConfig:
+    model: str = "resnet"  # "resnet" | "gpt"
+    opt_level: str = "O2"
+    loss_scale: Union[str, float] = "dynamic"
+    keep_batchnorm_fp32: Optional[bool] = None
+    optimizer: str = "adam"  # "adam" | "lamb" | "sgd"
+    n_devices: int = 1  # data-parallel width (1 = single device)
+    steps: int = 12
+    seed: int = 0
+    lr: float = 1e-2
+
+
+_GLOBAL_BATCH = 8
+_IMG, _CLASSES = 16, 10
+_SEQ = 16
+
+
+def _make_optimizer(cfg: RunConfig):
+    if cfg.optimizer == "adam":
+        return optimizers.FusedAdam(lr=cfg.lr, weight_decay=1e-4)
+    if cfg.optimizer == "lamb":
+        return optimizers.FusedLAMB(lr=cfg.lr, weight_decay=1e-4)
+    if cfg.optimizer == "sgd":
+        return optimizers.FusedSGD(lr=cfg.lr, momentum=0.9)
+    raise ValueError(cfg.optimizer)
+
+
+def _resnet_batch(step: int, seed: int):
+    # two fixed batches cycled: convergence is visible on synthetic data
+    # (fresh random labels every step have no learnable signal) while the
+    # trajectory still exercises more than one input
+    k = jax.random.fold_in(jax.random.PRNGKey(seed + 100), step % 2)
+    x = jax.random.normal(k, (_GLOBAL_BATCH, _IMG, _IMG, 3), jnp.float32)
+    y = jax.random.randint(jax.random.fold_in(k, 1), (_GLOBAL_BATCH,), 0, _CLASSES)
+    return x, y
+
+
+def _gpt_batch(step: int, seed: int, vocab: int):
+    k = jax.random.fold_in(jax.random.PRNGKey(seed + 200), step % 2)
+    tokens = jax.random.randint(k, (_GLOBAL_BATCH, _SEQ + 1), 0, vocab)
+    return tokens[:, :-1], tokens[:, 1:]
+
+
+def run_trajectory(cfg: RunConfig) -> List[float]:
+    """Train ``cfg.steps`` steps, return the per-step (pre-update) losses —
+    the per-iteration dump of reference tests/L1/common/main_amp.py."""
+    amp_state = amp.initialize(
+        cfg.opt_level,
+        loss_scale=cfg.loss_scale if cfg.loss_scale != "default" else None,
+        keep_batchnorm_fp32=cfg.keep_batchnorm_fp32,
+    )
+    opt = _make_optimizer(cfg)
+    dp = cfg.n_devices > 1
+    axis = "data" if dp else None
+
+    if cfg.model == "resnet":
+        model = ResNet(ResNetConfig(block_sizes=(1, 1), width=8,
+                                    num_classes=_CLASSES, bn_axis_name=axis))
+        params, model_state = model.init(jax.random.PRNGKey(cfg.seed))
+
+        def loss_fn(p, st, x, y):
+            logits, new_st = model.apply(p, st, x, training=True)
+            return softmax_cross_entropy_loss(
+                logits.astype(jnp.float32), y).mean(), new_st
+
+        batch_fn = lambda i: _resnet_batch(i, cfg.seed)
+    elif cfg.model == "gpt":
+        if dp:
+            raise NotImplementedError("L1 GPT runs single-device semantics")
+        gcfg = GPTConfig(num_layers=2, hidden_size=32, num_attention_heads=2,
+                         vocab_size=64, max_position_embeddings=_SEQ,
+                         bf16=cfg.opt_level in ("O2", "O3"))
+        model = GPTModel(gcfg)
+        master = model.init_master(jax.random.PRNGKey(cfg.seed))
+        params = model.shard_master(master, 0)
+        model_state = {}
+
+        def loss_fn(p, st, tokens, labels):
+            loss = model.apply(p, tokens, labels=labels)
+            return loss.mean(), st
+
+        batch_fn = lambda i: _gpt_batch(i, cfg.seed, gcfg.vocab_size)
+    else:
+        raise ValueError(cfg.model)
+
+    scaler = amp_state.scaler
+    grad_fn = amp.scaled_value_and_grad(loss_fn, scaler, has_aux=True)
+
+    def step_body(params, st, opt_state, scale_state, x, y):
+        half = amp_state.cast_model(params)
+        xc = amp_state.cast_inputs(x) if cfg.model == "resnet" else x
+        (loss, new_st), grads, finite = grad_fn(scale_state, half, st, xc, y)
+        if axis is not None:
+            grads = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, axis), grads)
+            finite = jax.lax.pmin(finite.astype(jnp.int32), axis) > 0
+            # reported loss is the global-batch mean (reference
+            # average_losses_across_data_parallel_group)
+            loss = jax.lax.pmean(loss, axis)
+        new_params, new_opt = opt.step(grads, opt_state, params)
+        params, opt_state = amp.skip_or_step(
+            finite, (new_params, new_opt), (params, opt_state))
+        scale_state = scaler.update(scale_state, finite)
+        return params, new_st, opt_state, scale_state, loss
+
+    opt_state = opt.init(params)
+    scale_state = scaler.init()
+
+    if dp:
+        mesh = Mesh(np.asarray(jax.devices()[: cfg.n_devices]), ("data",))
+        sharded = shard_map(
+            step_body, mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P("data"), P("data")),
+            out_specs=(P(), P(), P(), P(), P()),
+            check_rep=False)
+        step = jax.jit(sharded)
+    elif cfg.model == "gpt":
+        # the TP layers resolve a "tensor" axis even at tp=1: run the step
+        # replicated inside the parallel_state world mesh (the pattern of
+        # tests/L0/test_megatron_models.py)
+        from apex_tpu.transformer import parallel_state
+
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel(1, 1)
+        n_args = 6
+
+        def replicated(*args):
+            return shard_map(
+                step_body, mesh=mesh, in_specs=(P(),) * n_args,
+                out_specs=(P(),) * 5, check_rep=False)(*args)
+
+        step = jax.jit(replicated)
+    else:
+        step = jax.jit(step_body)
+
+    losses = []
+    st = model_state
+    for i in range(cfg.steps):
+        x, y = batch_fn(i)
+        params, st, opt_state, scale_state, loss = step(
+            params, st, opt_state, scale_state, x, y)
+        losses.append(float(loss))
+    return losses
+
+
+def compare_trajectories(a: List[float], b: List[float], *,
+                         bitwise: bool = True, rtol: float = 1e-5):
+    """Reference compare.py:40-64: bitwise where precision-identical,
+    tight tolerance otherwise."""
+    assert len(a) == len(b)
+    if bitwise:
+        mism = [(i, x, y) for i, (x, y) in enumerate(zip(a, b)) if x != y]
+        assert not mism, f"trajectories diverge bitwise at {mism[:3]}"
+    else:
+        np.testing.assert_allclose(a, b, rtol=rtol)

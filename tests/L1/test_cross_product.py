@@ -1,0 +1,81 @@
+"""L1 cross-product convergence/parity tier.
+
+Mirrors reference tests/L1: ResNet (and toy GPT) trained across
+opt-level × loss-scale × fused-optimizer (run_test.sh:29-60), trajectories
+compared bitwise between equivalent variants (compare.py:40-64) and checked
+for convergence everywhere.
+"""
+
+import pytest
+
+from tests.L1.common.harness import RunConfig, compare_trajectories, run_trajectory
+
+OPT_LEVELS = ["O0", "O1", "O2", "O3"]
+OPTIMIZERS = ["adam", "lamb"]
+
+
+@pytest.mark.parametrize("opt_level", OPT_LEVELS)
+@pytest.mark.parametrize("optimizer", OPTIMIZERS)
+def test_resnet_cross_product_converges(opt_level, optimizer):
+    """Every cell of the cross-product trains: finite losses, net decrease
+    (the run_test.sh sweep, pass/fail = trained-at-all + parity below)."""
+    traj = run_trajectory(RunConfig(
+        model="resnet", opt_level=opt_level, optimizer=optimizer,
+        loss_scale="dynamic" if opt_level in ("O1", "O2") else 1.0))
+    assert all(l == l and l < 1e4 for l in traj), traj  # finite
+    # two batches cycle; compare parity-aligned steps
+    assert traj[-2] < traj[0] and traj[-1] < traj[1], traj
+
+
+@pytest.mark.parametrize("opt_level", ["O0", "O2"])
+def test_resnet_determinism_bitwise(opt_level):
+    """Same config twice → bitwise-identical loss trajectory — the
+    compare.py discipline that catches nondeterminism (the reference needs
+    this to compare ext vs no-ext builds)."""
+    cfg = RunConfig(model="resnet", opt_level=opt_level)
+    compare_trajectories(run_trajectory(cfg), run_trajectory(cfg), bitwise=True)
+
+
+def test_resnet_dynamic_vs_static_scale_bitwise():
+    """Dynamic scaling at init 2^16 with no overflows == static 2^16,
+    bitwise (the scale value is the only thing the state machine changes,
+    and short clean runs never hit the growth window)."""
+    dyn = run_trajectory(RunConfig(model="resnet", opt_level="O2",
+                                   loss_scale="dynamic"))
+    static = run_trajectory(RunConfig(model="resnet", opt_level="O2",
+                                      loss_scale=2.0 ** 16))
+    compare_trajectories(dyn, static, bitwise=True)
+
+
+def test_resnet_keep_batchnorm_fp32_variants_converge():
+    """keep_batchnorm_fp32 axis of the reference cross-product."""
+    for keep in (True, False):
+        traj = run_trajectory(RunConfig(model="resnet", opt_level="O2",
+                                        keep_batchnorm_fp32=keep, steps=8))
+        assert traj[-2] < traj[0]
+
+
+def test_resnet_master_weights_drift_o2_vs_o0():
+    """O2 (bf16 compute, fp32 master) must track O0 (fp32) loosely — the
+    loss-parity sanity the reference checks across opt levels."""
+    o0 = run_trajectory(RunConfig(model="resnet", opt_level="O0",
+                                  loss_scale=1.0))
+    o2 = run_trajectory(RunConfig(model="resnet", opt_level="O2"))
+    # same trend, bf16-level tolerance
+    assert abs(o0[-1] - o2[-1]) < 0.15 * max(abs(o0[0]), 1.0)
+
+
+@pytest.mark.parametrize("opt_level", ["O0", "O2"])
+def test_gpt_converges_and_deterministic(opt_level):
+    cfg = RunConfig(model="gpt", opt_level=opt_level, steps=10, lr=5e-3)
+    a = run_trajectory(cfg)
+    assert a[-2] < a[0], a
+    compare_trajectories(a, run_trajectory(cfg), bitwise=True)
+
+
+def test_gpt_dynamic_vs_static_scale_bitwise():
+    dyn = run_trajectory(RunConfig(model="gpt", opt_level="O2", steps=8,
+                                   loss_scale="dynamic", lr=5e-3))
+    static = run_trajectory(RunConfig(model="gpt", opt_level="O2", steps=8,
+                                      loss_scale=2.0 ** 16, lr=5e-3))
+    compare_trajectories(dyn, static, bitwise=True)

@@ -1,0 +1,26 @@
+"""L1 distributed parity: 8-way data-parallel trajectory vs single device
+on the same global batch (reference tests/L1/cross_product_distributed/ —
+2-process DDP runs compared against single-GPU baselines)."""
+
+import pytest
+
+from tests.L1.common.harness import RunConfig, compare_trajectories, run_trajectory
+
+
+@pytest.mark.parametrize("opt_level,rtol", [("O0", 2e-3), ("O2", 3e-2)])
+def test_dp8_matches_single_device(opt_level, rtol):
+    """Same global batch split 8 ways (SyncBN pools the stats, grads pmean):
+    trajectory must match the 1-device run to fp reassociation tolerance
+    (bf16 compute under O2 drifts faster than fp32, hence the wider rtol —
+    step 0 is bitwise-identical in both modes)."""
+    single = run_trajectory(RunConfig(model="resnet", opt_level=opt_level,
+                                      loss_scale=1.0, steps=8))
+    dp = run_trajectory(RunConfig(model="resnet", opt_level=opt_level,
+                                  loss_scale=1.0, steps=8, n_devices=8))
+    assert single[0] == dp[0]
+    compare_trajectories(single, dp, bitwise=False, rtol=rtol)
+
+
+def test_dp8_deterministic_bitwise():
+    cfg = RunConfig(model="resnet", opt_level="O2", steps=8, n_devices=8)
+    compare_trajectories(run_trajectory(cfg), run_trajectory(cfg), bitwise=True)
