@@ -56,6 +56,10 @@ def _check_dim(g, v, dim: Optional[int]):
             raise ValueError("weight was normalized with dim=None; "
                              f"compute_weights got dim={dim}")
         return
+    if dim is None:
+        raise ValueError("weight was normalized with an integer dim "
+                         f"(g shape {tuple(g.shape)}); compute_weights got "
+                         "dim=None")
     want = tuple(v.shape[i] if i == dim % v.ndim else 1 for i in range(v.ndim))
     if tuple(g.shape) != want:
         raise ValueError(

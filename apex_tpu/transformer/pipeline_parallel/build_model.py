@@ -1,0 +1,77 @@
+"""Generic pipeline model construction (reference
+apex/transformer/pipeline_parallel/schedules/common.py:18-106).
+
+The reference ``build_model(model_provider_func, wrap_with_ddp, ...)``
+instantiates one module per virtual-pipeline chunk, calling the provider
+with ``pre_process`` / ``post_process`` flags derived from the stage
+position, then optionally wraps each chunk in torch DDP. Here the same
+contract, functionally:
+
+- ``model_provider_func(pre_process=..., post_process=...) -> model`` where
+  a *model* is any object with ``init(key) -> params`` (or ``init_master``)
+  and ``apply(params, hidden_or_batch, ...)``;
+- :func:`build_model` returns the list of chunk models — one entry without
+  virtual pipelining, ``vpp_size`` entries with it — with the virtual rank
+  cursor set around each call exactly as the reference does
+  (common.py:46-59);
+- DDP wrapping has no object to wrap in JAX: data parallelism is a psum in
+  the train step, so ``wrap_with_ddp`` instead attaches the data-parallel
+  axis name the step should reduce over (the moral equivalent of
+  common.py:95-105).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+from apex_tpu.transformer import parallel_state
+
+
+def build_model(
+    model_provider_func: Callable[..., Any],
+    wrap_with_ddp: bool = True,
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    *args,
+    **kwargs,
+) -> List[Any]:
+    """Reference common.py:18-106. Returns a list of chunk models.
+
+    Provider calls receive ``pre_process`` (this chunk starts with the
+    embedding / stem) and ``post_process`` (this chunk ends with the head /
+    loss) computed from the pipeline + virtual ranks.
+    """
+    pp_size = parallel_state.get_pipeline_model_parallel_world_size()
+    vpp = virtual_pipeline_model_parallel_size
+    # SPMD divergence from the reference: there is no per-stage Python
+    # process — ONE program spans every pipeline stage, so each chunk's
+    # param structure must include both ends and the stage gating happens
+    # inside the traced step (where-masked on the traced pipeline rank, the
+    # make_gpt_stage_fns pattern). The flags are therefore True whenever
+    # this chunk COULD sit at that end of the pipe; they go False only for
+    # middle virtual chunks, which no stage placement ever maps to an end.
+    if pp_size > 1 and vpp is not None:
+        models = []
+        for v in range(vpp):
+            # the provider may consult the virtual cursor (common.py:49-52)
+            parallel_state.set_virtual_pipeline_model_parallel_rank(v)
+            models.append(
+                model_provider_func(
+                    *args,
+                    pre_process=(v == 0),
+                    post_process=(v == vpp - 1),
+                    **kwargs,
+                )
+            )
+        parallel_state.set_virtual_pipeline_model_parallel_rank(0)
+    else:
+        models = [
+            model_provider_func(*args, pre_process=True, post_process=True,
+                                **kwargs)
+        ]
+    if wrap_with_ddp:
+        for m in models:
+            # the step reduces grads over this axis (stands in for the
+            # torchDDP wrap of common.py:95-105)
+            setattr(m, "data_parallel_axis",
+                    parallel_state.get_data_parallel_group())
+    return models

@@ -1,0 +1,72 @@
+"""Generic build_model tests (reference schedules/common.py:18-106) plus the
+simple distributed example as a subprocess smoke test."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.pipeline_parallel.build_model import build_model
+
+
+class _Chunk:
+    def __init__(self, pre_process, post_process, tag):
+        self.pre_process = pre_process
+        self.post_process = post_process
+        self.tag = tag
+
+
+def _provider(pre_process=False, post_process=False, tag="x"):
+    return _Chunk(pre_process, post_process, tag)
+
+
+def setup_function(_):
+    parallel_state.destroy_model_parallel()
+
+
+def test_single_chunk_flags():
+    parallel_state.initialize_model_parallel(1, 1)
+    models = build_model(_provider, tag="m")
+    assert len(models) == 1
+    # pp=1: the only stage is both first and last
+    assert models[0].pre_process and models[0].post_process
+    assert models[0].tag == "m"
+    assert models[0].data_parallel_axis == "data"
+    parallel_state.destroy_model_parallel()
+
+
+def test_no_ddp_wrap():
+    parallel_state.initialize_model_parallel(1, 1)
+    models = build_model(_provider, wrap_with_ddp=False)
+    assert not hasattr(models[0], "data_parallel_axis")
+    parallel_state.destroy_model_parallel()
+
+
+def test_virtual_chunks():
+    parallel_state.initialize_model_parallel(1, 4, 2)
+    models = build_model(_provider, virtual_pipeline_model_parallel_size=2)
+    assert len(models) == 2
+    # first chunk may hold the embedding end, last chunk the head end
+    assert models[0].pre_process and not models[0].post_process
+    assert models[1].post_process and not models[1].pre_process
+    # cursor restored after building (common.py:59)
+    assert parallel_state.get_virtual_pipeline_model_parallel_rank() == 0
+    parallel_state.destroy_model_parallel()
+
+
+def test_simple_distributed_example_runs():
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    script = os.path.join(os.path.dirname(__file__), "..", "..", "examples",
+                          "simple", "distributed",
+                          "distributed_data_parallel.py")
+    out = subprocess.run([sys.executable, script], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "final loss:" in out.stdout
+    first = float(out.stdout.split("loss ")[1].split()[0])
+    final = float(out.stdout.rsplit("final loss:", 1)[1])
+    assert final < first

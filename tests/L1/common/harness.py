@@ -76,7 +76,7 @@ def run_trajectory(cfg: RunConfig) -> List[float]:
     the per-iteration dump of reference tests/L1/common/main_amp.py."""
     amp_state = amp.initialize(
         cfg.opt_level,
-        loss_scale=cfg.loss_scale if cfg.loss_scale != "default" else None,
+        loss_scale=cfg.loss_scale,
         keep_batchnorm_fp32=cfg.keep_batchnorm_fp32,
     )
     opt = _make_optimizer(cfg)

@@ -1,0 +1,67 @@
+"""End-to-end smoke tests of the ImageNet training CLI (the examples tier —
+reference examples/imagenet/main_amp.py driven by tests/L1/common/run_test.sh).
+Runs the real main() with tiny shapes: train, checkpoint, resume, evaluate,
+data-parallel."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+
+def _load_main():
+    path = os.path.join(os.path.dirname(__file__), "..", "..",
+                        "examples", "imagenet", "main_amp.py")
+    spec = importlib.util.spec_from_file_location("imagenet_main_amp", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+TINY = ["--arch", "resnet_tiny", "--image-size", "16", "--num-classes", "10",
+        "-b", "8", "--steps-per-epoch", "6", "--eval-steps", "2",
+        "--print-freq", "3", "--lr", "0.01"]
+
+
+def test_train_eval_o2(capsys):
+    mod = _load_main()
+    state = mod.main(TINY + ["--epochs", "1", "--opt-level", "O2",
+                             "--optimizer", "lamb"])
+    out = capsys.readouterr().out
+    assert "Prec@1" in out and "img/s" in out
+    assert int(state.step) == 6
+
+
+def test_checkpoint_resume(tmp_path, capsys):
+    mod = _load_main()
+    d = str(tmp_path / "ckpts")
+    mod.main(TINY + ["--epochs", "1", "--save-dir", d])
+    assert os.path.isdir(d)
+    state = mod.main(TINY + ["--epochs", "2", "--save-dir", d, "--resume", d])
+    out = capsys.readouterr().out
+    assert "resumed" in out
+    # resumed run trains only epoch 1 (6 more steps on top of the 6 saved)
+    assert int(state.step) == 12
+
+
+def test_evaluate_only(capsys):
+    mod = _load_main()
+    mod.main(TINY + ["--epochs", "1", "--evaluate"])
+    out = capsys.readouterr().out
+    assert "Prec@1" in out and "Epoch" not in out
+
+
+def test_data_parallel_sync_bn(capsys):
+    mod = _load_main()
+    state = mod.main(TINY + ["--epochs", "1", "--n-devices", "8", "--sync_bn",
+                             "--opt-level", "O2"])
+    assert int(state.step) == 6
+    out = capsys.readouterr().out
+    assert "Prec@1" in out
+
+
+def test_bad_batch_split():
+    mod = _load_main()
+    with pytest.raises(ValueError):
+        mod.main(TINY + ["--epochs", "1", "--n-devices", "3"])
