@@ -104,11 +104,130 @@ class TestFlashAttention:
         q = jax.random.normal(jax.random.PRNGKey(0), (2, 256, 128))
         k = jax.random.normal(jax.random.PRNGKey(1), (2, 256, 128))
         v = jax.random.normal(jax.random.PRNGKey(2), (2, 256, 128))
-        o, lse = _flash_fwd_pallas(q, k, v, 1.0 / np.sqrt(128.0), True,
-                                   128, 128)
+        o, lse = _flash_fwd_pallas(q, k, v, None, None, None,
+                                   1.0 / np.sqrt(128.0), True, 128, 128)
         np.testing.assert_allclose(o, _naive(q, k, v, True), rtol=1e-4,
                                    atol=1e-5)
         assert lse.shape == (2, 256)
+
+    @pytest.mark.parametrize("causal,with_mask,with_seg", [
+        (False, False, False),
+        (True, False, False),
+        (False, True, False),
+        (False, False, True),
+        (True, True, False),
+        # per-head mask [bh,...] + shared segments [1,...] together: the
+        # batch selectors of the two BlockSpec families must not cross
+        (False, True, True),
+    ])
+    def test_pallas_bwd_interpret_matches(self, causal, with_mask, with_seg):
+        """The Pallas dq/dkv kernels (interpret mode) against jax.grad of
+        the naive reference — every mask/seg/causal combination."""
+        from apex_tpu.ops.attention import (
+            _flash_bwd_pallas, _flash_fwd_pallas)
+        bh, s, d = 2, 64, 16
+        q = jax.random.normal(jax.random.PRNGKey(0), (bh, s, d))
+        k = jax.random.normal(jax.random.PRNGKey(1), (bh, s, d))
+        v = jax.random.normal(jax.random.PRNGKey(2), (bh, s, d))
+        do = jax.random.normal(jax.random.PRNGKey(3), (bh, s, d))
+        bias = jnp.where(
+            jax.random.bernoulli(jax.random.PRNGKey(4), 0.3, (bh, s, s)),
+            -10000.0, 0.0) if with_mask else None
+        seg = (jnp.concatenate([jnp.zeros((1, 24), jnp.int32),
+                                jnp.ones((1, 40), jnp.int32)], axis=1)
+               if with_seg else None)
+        scale = 1.0 / np.sqrt(d)
+        o, lse = _flash_fwd_pallas(q, k, v, bias, seg, seg, scale, causal,
+                                   16, 16)
+        dq, dk, dv = _flash_bwd_pallas(q, k, v, bias, seg, seg, o, lse, do,
+                                       scale, causal, 16, 16)
+
+        def ref(q, k, v):
+            s_ = jnp.einsum("bqd,bkd->bqk", q, k) * scale
+            if bias is not None:
+                s_ = s_ + bias
+            if seg is not None:
+                s_ = jnp.where(seg[:, :, None] == seg[:, None, :], s_, -1e30)
+            if causal:
+                tri = jnp.tril(jnp.ones((s, s), bool))
+                s_ = jnp.where(tri, s_, -1e30)
+            return jnp.sum(jax.nn.softmax(s_, -1) @ v * do)
+
+        gq, gk, gv = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(dq, gq, rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(dk, gk, rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(dv, gv, rtol=1e-3, atol=1e-4)
+
+    def test_segment_ids_public_api(self):
+        """segment_ids masks cross-segment attention — equal to running the
+        two segments separately."""
+        q = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 8))
+        k = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 8))
+        v = jax.random.normal(jax.random.PRNGKey(2), (2, 32, 8))
+        seg = jnp.array([0] * 12 + [1] * 20)
+        out = flash_attention(q, k, v, segment_ids=seg)
+        a = _naive(q[:, :12], k[:, :12], v[:, :12])
+        b = _naive(q[:, 12:], k[:, 12:], v[:, 12:])
+        np.testing.assert_allclose(out, jnp.concatenate([a, b], axis=1),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_segment_ids_grads(self):
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 24, 8))
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 24, 8))
+        v = jax.random.normal(jax.random.PRNGKey(2), (1, 24, 8))
+        seg = jnp.array([0] * 8 + [1] * 16)
+
+        def f(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, segment_ids=seg) ** 2)
+
+        def f_ref(q, k, v):
+            a = jnp.sum(_naive(q[:, :8], k[:, :8], v[:, :8]) ** 2)
+            b = jnp.sum(_naive(q[:, 8:], k[:, 8:], v[:, 8:]) ** 2)
+            return a + b
+
+        g1 = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-4)
+
+
+class TestVarlen:
+    """flash_attention_varlen — the reference FMHA's BERT-style packed
+    interface (contrib/fmha/fmha.py:33-75), mapped to segment-id masking."""
+
+    def test_matches_per_sequence(self):
+        h, d = 2, 8
+        lens = [5, 11, 8]
+        total = 32  # includes 8 padding tokens
+        cu = jnp.array([0, 5, 16, 24], jnp.int32)
+        q = jax.random.normal(jax.random.PRNGKey(0), (total, h, d))
+        k = jax.random.normal(jax.random.PRNGKey(1), (total, h, d))
+        v = jax.random.normal(jax.random.PRNGKey(2), (total, h, d))
+        from apex_tpu.ops.attention import flash_attention_varlen
+        out = flash_attention_varlen(q, k, v, cu)
+        assert out.shape == (total, h, d)
+        start = 0
+        for n in lens:
+            sl = slice(start, start + n)
+            ref = _naive(q[sl].transpose(1, 0, 2), k[sl].transpose(1, 0, 2),
+                         v[sl].transpose(1, 0, 2))
+            np.testing.assert_allclose(out[sl].transpose(1, 0, 2), ref,
+                                       rtol=1e-4, atol=1e-5)
+            start += n
+
+    def test_causal_varlen(self):
+        h, d = 1, 8
+        cu = jnp.array([0, 6, 16], jnp.int32)
+        q = jax.random.normal(jax.random.PRNGKey(0), (16, h, d))
+        k = jax.random.normal(jax.random.PRNGKey(1), (16, h, d))
+        v = jax.random.normal(jax.random.PRNGKey(2), (16, h, d))
+        from apex_tpu.ops.attention import flash_attention_varlen
+        out = flash_attention_varlen(q, k, v, cu, causal=True)
+        for sl in (slice(0, 6), slice(6, 16)):
+            ref = _naive(q[sl].transpose(1, 0, 2), k[sl].transpose(1, 0, 2),
+                         v[sl].transpose(1, 0, 2), causal=True)
+            np.testing.assert_allclose(out[sl].transpose(1, 0, 2), ref,
+                                       rtol=1e-4, atol=1e-5)
 
 
 class TestRingAttention:
@@ -164,6 +283,32 @@ class TestRingAttention:
         g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-4)
+
+    def test_backward_memory_flat_in_world_size(self):
+        """The custom-VJP second ring pass must not save rotated K/V blocks:
+        per-device temp memory of the compiled grad stays flat as the ring
+        grows 2 → 8 devices at constant local shard (VERDICT r1 weak #4)."""
+
+        def temp_bytes(n_dev, s_local):
+            m = Mesh(np.array(jax.devices()[:n_dev]), ("sp",))
+            qg = jnp.zeros((2, s_local * n_dev, 16))
+
+            def loss(q, k, v):
+                def run(q, k, v):
+                    o = ring_attention(q, k, v, "sp", causal=True)
+                    return jax.lax.psum(jnp.sum(o ** 2), "sp")
+                return shard_map(run, mesh=m, in_specs=(P(None, "sp"),) * 3,
+                                 out_specs=P(), check_rep=False)(q, k, v)
+
+            c = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(
+                qg, qg, qg).compile()
+            stats = c.memory_analysis()
+            assert stats is not None and stats.temp_size_in_bytes > 0
+            return stats.temp_size_in_bytes
+
+        b2 = temp_bytes(2, 32)
+        b8 = temp_bytes(8, 32)
+        assert b8 < b2 * 2.0, (b2, b8)
 
 
 class TestMultiheadAttnModules:
