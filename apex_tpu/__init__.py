@@ -32,6 +32,7 @@ from apex_tpu import multi_tensor  # noqa: F401
 from apex_tpu import ops  # noqa: F401
 from apex_tpu import optimizers  # noqa: F401
 from apex_tpu import parallel  # noqa: F401
+from apex_tpu import profiling  # noqa: F401
 from apex_tpu import transformer  # noqa: F401
 from apex_tpu.utils.logging import RankInfoFormatter, get_logger  # noqa: F401
 

@@ -31,8 +31,9 @@ class Optimizer:
 
     def step(self, grads, state, params):
         """Apply one optimizer step: returns ``(new_params, new_state)``."""
-        updates, state = self.update(grads, state, params)
-        return apply_updates(params, updates), state
+        with jax.named_scope(f"apex_{type(self).__name__}_step"):
+            updates, state = self.update(grads, state, params)
+            return apply_updates(params, updates), state
 
     def step_if_finite(self, grads, state, params, finite):
         """amp-integrated step: branchless skip on overflow (the reference

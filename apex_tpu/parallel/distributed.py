@@ -70,7 +70,10 @@ def all_reduce_grads(
             g = g * gradient_predivide_factor
         return g.astype(dtype)
 
-    return jax.tree_util.tree_map(reduce_one, grads)
+    # named_scope = the reference's NVTX range around its allreduces
+    # (distributed.py:359-403): shows up in HLO op names and device traces
+    with jax.named_scope("apex_allreduce_grads"):
+        return jax.tree_util.tree_map(reduce_one, grads)
 
 
 def broadcast_params(params: Any, axis_name: str = "data") -> Any:

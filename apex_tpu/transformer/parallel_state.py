@@ -47,6 +47,9 @@ class _ParallelState:
     # mutable scheduling cursor used by the interleaved schedule, mirroring
     # get/set_virtual_pipeline_model_parallel_rank (reference :100-107)
     virtual_pipeline_model_parallel_rank: int = 0
+    # host-side (tp, pp, dp) coordinates of this process's first mesh
+    # device, precomputed once (get_rank_info is called per log record)
+    rank_info: Tuple[int, int, int] = (0, 0, 0)
 
 
 _STATE: Optional[_ParallelState] = None
@@ -87,6 +90,7 @@ def initialize_model_parallel(
         pipeline_model_parallel_size=pp,
         data_parallel_size=dp,
         virtual_pipeline_model_parallel_size=virtual_pipeline_model_parallel_size_,
+        rank_info=_compute_rank_info(mesh),
     )
     return mesh
 
@@ -214,11 +218,26 @@ def get_tensor_model_parallel_src_rank() -> int:
 
 def get_rank_info() -> Tuple[int, int, int]:
     """(tp, pp, dp) rank triple for log records (reference :169-178).
-    Host-side: process-level info only (single-controller SPMD has no
-    per-device host rank), so returns zeros outside traced code."""
+
+    Host-side (outside traced code) a process owns a *block* of mesh
+    coordinates, not a single rank; reports the coordinates of the first
+    mesh device this process owns — in multi-host runs that is the
+    process's real (tp, pp, dp) position, and on a single host it is
+    (0, 0, 0) like the reference's rank-0 logs.  Precomputed at
+    :func:`initialize_model_parallel` (called per log record)."""
     if _STATE is None:
         return (0, 0, 0)
-    return (0, 0, jax.process_index())
+    return _STATE.rank_info
+
+
+def _compute_rank_info(mesh: Mesh) -> Tuple[int, int, int]:
+    pid = jax.process_index()
+    arr = np.asarray(mesh.devices)
+    for idx in np.ndindex(arr.shape):
+        if arr[idx].process_index == pid:
+            dp_i, pp_i, tp_i = idx
+            return (int(tp_i), int(pp_i), int(dp_i))
+    return (0, 0, pid)
 
 
 def destroy_model_parallel() -> None:
