@@ -1,0 +1,152 @@
+"""Native host runtime: lazy g++ build + ctypes bindings.
+
+The reference ships its host/device native layer as setuptools CUDA
+extensions (setup.py:77-527).  The TPU build's device kernels are Pallas;
+what stays native here is the *host* runtime — multi-tensor pack/unpack
+(apex_C parity, flatten.cpp) and the prefetching record loader
+(dataloader.cpp, the DALI role).  Sources compile lazily with g++ into a
+shared object cached next to the package (keyed by source digest), bound
+through ctypes — pybind11 is deliberately not required.
+
+Everything degrades gracefully: if no toolchain is present,
+``available()`` is False and callers fall back to numpy paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ("flatten.cpp", "dataloader.cpp")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _digest() -> str:
+    h = hashlib.sha256()
+    for s in _SOURCES:
+        with open(os.path.join(_SRC_DIR, s), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:12]
+
+
+def _build() -> ctypes.CDLL:
+    out = os.path.join(_SRC_DIR, f"_native_{_digest()}.so")
+    if not os.path.exists(out):
+        srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
+        # build to a temp name then rename: atomic against concurrent builds
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_SRC_DIR)
+        os.close(fd)
+        try:
+            subprocess.run(
+                ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-pthread",
+                 *srcs, "-o", tmp],
+                check=True, capture_output=True, text=True)
+            os.replace(tmp, out)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+    lib = ctypes.CDLL(out)
+    lib.apex_tpu_pack.restype = None
+    lib.apex_tpu_unpack.restype = None
+    lib.axl_open.restype = ctypes.c_void_p
+    lib.axl_open.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+        ctypes.c_int]
+    lib.axl_next.restype = ctypes.c_int
+    lib.axl_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.axl_num_records.restype = ctypes.c_int64
+    lib.axl_num_records.argtypes = [ctypes.c_void_p]
+    lib.axl_close.restype = None
+    lib.axl_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _build_error
+    if _lib is not None or _build_error is not None:
+        return _lib
+    with _lock:
+        if _lib is None and _build_error is None:
+            try:
+                _lib = _build()
+            except Exception as e:  # no toolchain / build failure
+                _build_error = repr(e)
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def build_error() -> Optional[str]:
+    get_lib()
+    return _build_error
+
+
+# ---------------------------------------------------------------------------
+# pack/unpack (apex_C flatten/unflatten parity, host side)
+# ---------------------------------------------------------------------------
+
+
+def pack_host(arrays: Sequence[np.ndarray], offsets: Sequence[int],
+              total_bytes: int, *, threads: int = 0,
+              out: Optional[np.ndarray] = None) -> np.ndarray:
+    """Gather numpy arrays into one byte buffer at the given byte offsets.
+
+    The native path threads the memcpys; without the toolchain this falls
+    back to a numpy loop with identical results.
+    """
+    if out is None:
+        out = np.zeros(total_bytes, np.uint8)
+    assert out.nbytes >= total_bytes
+    arrs = [np.ascontiguousarray(a) for a in arrays]
+    lib = get_lib()
+    if lib is None:
+        for a, off in zip(arrs, offsets):
+            out[off:off + a.nbytes] = a.view(np.uint8).reshape(-1)
+        return out
+    n = len(arrs)
+    srcs = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrs])
+    nbytes = (ctypes.c_int64 * n)(*[a.nbytes for a in arrs])
+    offs = (ctypes.c_int64 * n)(*list(offsets))
+    threads = threads or min(8, max(1, os.cpu_count() or 1))
+    lib.apex_tpu_pack(
+        srcs, nbytes, offs, ctypes.c_int64(n),
+        ctypes.c_void_p(out.ctypes.data), ctypes.c_int(threads))
+    return out
+
+
+def unpack_host(buf: np.ndarray, arrays: Sequence[np.ndarray],
+                offsets: Sequence[int], *, threads: int = 0) -> None:
+    """Scatter a byte buffer back into the (preallocated, contiguous)
+    numpy arrays at the given byte offsets — in place."""
+    buf = np.ascontiguousarray(buf.view(np.uint8).reshape(-1))
+    lib = get_lib()
+    if lib is None:
+        for a, off in zip(arrays, offsets):
+            flat = a.view(np.uint8).reshape(-1)
+            flat[:] = buf[off:off + a.nbytes]
+        return
+    n = len(arrays)
+    for a in arrays:
+        assert a.flags["C_CONTIGUOUS"], "unpack_host needs contiguous dsts"
+    dsts = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrays])
+    nbytes = (ctypes.c_int64 * n)(*[a.nbytes for a in arrays])
+    offs = (ctypes.c_int64 * n)(*list(offsets))
+    threads = threads or min(8, max(1, os.cpu_count() or 1))
+    lib.apex_tpu_unpack(
+        ctypes.c_void_p(buf.ctypes.data), nbytes, offs,
+        ctypes.c_int64(n), dsts, ctypes.c_int(threads))

@@ -54,12 +54,14 @@ def _ln_fwd_kernel(x_ref, w_ref, b_ref, y_ref, mean_ref, invvar_ref, *, eps, n_c
     invvar = jax.lax.rsqrt(var + eps)
     y = xc * invvar
     if w_ref is not None:
-        y = y * w_ref[...].astype(jnp.float32)[None, :]
+        y = y * w_ref[0].astype(jnp.float32)[None, :]
     if b_ref is not None:
-        y = y + b_ref[...].astype(jnp.float32)[None, :]
+        y = y + b_ref[0].astype(jnp.float32)[None, :]
     y_ref[...] = y.astype(y_ref.dtype)
-    mean_ref[...] = mean[:, 0]
-    invvar_ref[...] = invvar[:, 0]
+    # stats keep a trailing singleton lane dim: Mosaic rejects 1-D
+    # operands whose tiling disagrees with the XLA layout
+    mean_ref[...] = mean
+    invvar_ref[...] = invvar
 
 
 def _pallas_ln_fwd(x2d, weight, bias, eps):
@@ -78,28 +80,28 @@ def _pallas_ln_fwd(x2d, weight, bias, eps):
     in_specs = [pl.BlockSpec((block_rows, cols), lambda i: (i, 0))]
     args = [x2d]
     if has_w:
-        in_specs.append(pl.BlockSpec((cols,), lambda i: (0,)))
-        args.append(weight)
+        in_specs.append(pl.BlockSpec((1, cols), lambda i: (0, 0)))
+        args.append(weight[None, :])
     if has_b:
-        in_specs.append(pl.BlockSpec((cols,), lambda i: (0,)))
-        args.append(bias)
+        in_specs.append(pl.BlockSpec((1, cols), lambda i: (0, 0)))
+        args.append(bias[None, :])
     y, mean, invvar = pl.pallas_call(
         kernel,
         grid=(grid,),
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((block_rows, cols), lambda i: (i, 0)),
-            pl.BlockSpec((block_rows,), lambda i: (i,)),
-            pl.BlockSpec((block_rows,), lambda i: (i,)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rows, cols), x2d.dtype),
-            jax.ShapeDtypeStruct((rows,), jnp.float32),
-            jax.ShapeDtypeStruct((rows,), jnp.float32),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
         ],
         interpret=use_interpret(),
     )(*args)
-    return y, mean, invvar
+    return y, mean[:, 0], invvar[:, 0]
 
 
 def _xla_ln_fwd(x2d, weight, bias, eps):

@@ -41,6 +41,7 @@ __all__ = [
     "start_trace",
     "stop_trace",
     "cost_report",
+    "cost_report_from_compiled",
     "format_cost_report",
     "CostReport",
 ]
@@ -162,14 +163,10 @@ def _opcode_histogram(compiled) -> Dict[str, int]:
     return dict(hist)
 
 
-def cost_report(fn: Callable, *args, static_argnums=(), **kwargs
-                ) -> CostReport:
-    """Compile ``fn`` for the current backend and return its cost report.
-
-    ``fn`` may already be jitted; plain callables are jitted here."""
-    jitted = fn if hasattr(fn, "lower") else jax.jit(
-        fn, static_argnums=static_argnums)
-    compiled = jitted.lower(*args, **kwargs).compile()
+def cost_report_from_compiled(compiled) -> CostReport:
+    """Cost report for an already-compiled executable
+    (``jax.stages.Compiled``) — lets callers that compile once for both
+    analysis and execution avoid a second compile."""
     cost = compiled.cost_analysis() or {}
     # cost_analysis returns a dict (or a single-element list of dicts on
     # older jax) of float metrics
@@ -184,6 +181,16 @@ def cost_report(fn: Callable, *args, static_argnums=(), **kwargs
         temp_bytes=int(getattr(mem, "temp_size_in_bytes", 0) or 0),
         opcode_histogram=_opcode_histogram(compiled),
     )
+
+
+def cost_report(fn: Callable, *args, static_argnums=(), **kwargs
+                ) -> CostReport:
+    """Compile ``fn`` for the current backend and return its cost report.
+
+    ``fn`` may already be jitted; plain callables are jitted here."""
+    jitted = fn if hasattr(fn, "lower") else jax.jit(
+        fn, static_argnums=static_argnums)
+    return cost_report_from_compiled(jitted.lower(*args, **kwargs).compile())
 
 
 def format_cost_report(report: CostReport, *, top: int = 12,

@@ -65,6 +65,11 @@ class GPTConfig:
     fp16: bool = False
     bf16: bool = False
     tp_size: int = 1
+    # TPU-first extensions beyond the reference's arguments set:
+    # use the Pallas flash kernel for causal self-attention (no S×S
+    # probs materialised) and rematerialise each layer in backward
+    use_flash_attention: bool = False
+    remat: bool = False
 
     @property
     def ffn(self) -> int:
@@ -123,6 +128,18 @@ class ParallelAttention:
         qkv = self.qkv.apply(params["qkv"], h)  # [b, s, 3*hidden/tp]
         qkv = qkv.reshape(b, s, self.np_local, 3 * cfg.kv_channels)
         q, k, v = jnp.split(qkv, 3, axis=-1)  # each [b, s, np, hn]
+        if cfg.use_flash_attention and attention_mask is None:
+            # Pallas flash kernel, causal (the model's mask type): heads
+            # fold into the batch dim, no S×S probs in HBM
+            from apex_tpu.ops.attention import flash_attention
+
+            qh = q.transpose(0, 2, 1, 3)  # [b, np, s, hn]
+            kh = k.transpose(0, 2, 1, 3)
+            vh = v.transpose(0, 2, 1, 3)
+            ctx = flash_attention(qh, kh, vh, causal=True)
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(
+                b, s, self.np_local * cfg.kv_channels).astype(h.dtype)
+            return self.proj.apply(params["proj"], ctx)
         # scores [b, np, s, s]; scale 1/sqrt(hn) matches norm_factor (:389)
         scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.kv_channels, jnp.float32))
         scores = jnp.einsum("bqnh,bknh->bnqk", q, k,
@@ -233,6 +250,11 @@ class ParallelTransformer:
         def body(carry, layer_params):
             return self.layer.apply(layer_params, carry, attention_mask), None
 
+        if self.cfg.remat:
+            # save only layer boundaries; recompute inside each layer on
+            # backward (reference activation checkpointing, random.py TPU
+            # mapping) — activation memory O(L·B·S·H) → O(B·S·H)
+            body = jax.checkpoint(body)
         h, _ = jax.lax.scan(body, h, params["layers"])
         return h
 

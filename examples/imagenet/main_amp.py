@@ -112,8 +112,16 @@ def accuracy(logits, target, topk=(1,)):
 
 
 def make_batcher(args):
-    """Synthetic-or-directory input pipeline."""
+    """Synthetic, native-record, or directory input pipeline."""
     if args.data != "synthetic" and os.path.isdir(args.data):
+        import glob
+        if glob.glob(os.path.join(args.data, "train*.rec")):
+            return _native_records_batcher(args)
+        if glob.glob(os.path.join(args.data, "*.rec")):
+            raise ValueError(
+                f"{args.data} has .rec files but none matching train*.rec "
+                "— the native backend expects train*.rec (+ optional "
+                "val*.rec)")
         try:
             return _directory_batcher(args)
         except ImportError:
@@ -129,6 +137,46 @@ def make_batcher(args):
                                (args.batch_size,), 0, args.num_classes)
         return x, y
 
+    return batch
+
+
+def _native_records_batcher(args):
+    """C++ prefetching loader over packed record files (the reference's
+    DALI data-backend role, examples/imagenet/main_amp.py --data-backend).
+
+    Record layout: uint8 HWC image then int32 label; files
+    ``<data>/train*.rec`` (shuffled) and ``<data>/val*.rec``
+    (sequential; falls back to the train files when absent).  Produce the
+    files with ``apex_tpu.data.write_records``.
+    """
+    import glob
+
+    import numpy as np
+
+    from apex_tpu.data import NativeRecordLoader
+
+    rb = args.image_size * args.image_size * 3 + 4
+
+    def decode(b):
+        imgs = b[:, :-4].reshape(-1, args.image_size, args.image_size, 3)
+        labels = b[:, -4:].copy().view(np.int32).ravel()
+        x = imgs.astype(np.float32) / 255.0 * 2.0 - 1.0
+        return jnp.asarray(x), jnp.asarray(labels)
+
+    train_paths = sorted(glob.glob(os.path.join(args.data, "train*.rec")))
+    val_paths = (sorted(glob.glob(os.path.join(args.data, "val*.rec")))
+                 or train_paths)
+    train_loader = NativeRecordLoader(train_paths, rb, args.batch_size,
+                                      shuffle=True, seed=args.seed,
+                                      decode=decode)
+    val_loader = NativeRecordLoader(val_paths, rb, args.batch_size,
+                                    shuffle=False, decode=decode)
+
+    def batch(epoch, step, train=True):
+        return (train_loader if train else val_loader).next_batch()
+
+    # main() closes this at exit to reap the C++ worker threads/fds
+    batch.close = lambda: (train_loader.close(), val_loader.close())
     return batch
 
 
@@ -318,20 +366,25 @@ def main(argv=None):
         else:
             print(f"=> no checkpoint found at '{args.resume}'")
 
-    if args.evaluate:
-        validate(state, eval_fn, batcher, args)
-        return state
+    try:
+        if args.evaluate:
+            validate(state, eval_fn, batcher, args)
+            return state
 
-    best_prec1 = 0.0
-    for epoch in range(start_epoch, args.epochs):
-        state, train_loss = train_epoch(epoch, state, step_fn, batcher, args)
-        prec1 = validate(state, eval_fn, batcher, args)
-        best_prec1 = max(best_prec1, prec1)
-        if args.save_dir:
-            ckpt.save_checkpoint(args.save_dir, state, step=epoch, keep=3)
-            print(f"=> saved checkpoint (epoch {epoch})")
-    print(f"Best Prec@1: {best_prec1:.3f}")
-    return state
+        best_prec1 = 0.0
+        for epoch in range(start_epoch, args.epochs):
+            state, train_loss = train_epoch(epoch, state, step_fn, batcher,
+                                            args)
+            prec1 = validate(state, eval_fn, batcher, args)
+            best_prec1 = max(best_prec1, prec1)
+            if args.save_dir:
+                ckpt.save_checkpoint(args.save_dir, state, step=epoch, keep=3)
+                print(f"=> saved checkpoint (epoch {epoch})")
+        print(f"Best Prec@1: {best_prec1:.3f}")
+        return state
+    finally:
+        # native-record batchers expose close() to reap C++ worker threads
+        getattr(batcher, "close", lambda: None)()
 
 
 if __name__ == "__main__":

@@ -279,3 +279,69 @@ class TestBert:
 
         np.testing.assert_allclose(loss_for_tp(4), loss_for_tp(1),
                                    rtol=2e-4, atol=1e-5)
+
+
+class TestFlashAndRemat:
+    """The TPU-first GPTConfig extensions (use_flash_attention, remat) must
+    not change the math: same master weights -> same loss as the
+    reference-shaped softmax path."""
+
+    def _loss(self, cfg, master, tokens, labels):
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel(1, 1)
+        model = GPTModel(cfg)
+        p = model.shard_master(master, 0)
+
+        def run(p, t, l):
+            return jnp.mean(model.apply(p, t, labels=l))
+
+        out = shard_map(run, mesh=mesh, in_specs=(P(), P(), P()),
+                        out_specs=P(), check_rep=False)(p, tokens, labels)
+        parallel_state.destroy_model_parallel()
+        return float(out)
+
+    def test_flash_and_remat_match_reference_path(self):
+        kw = dict(num_layers=2, hidden_size=32, num_attention_heads=4,
+                  vocab_size=VOCAB, max_position_embeddings=SEQ, tp_size=1)
+        master = GPTModel(GPTConfig(**kw)).init_master(jax.random.PRNGKey(0))
+        tokens = _tokens(jax.random.PRNGKey(1))
+        labels = _tokens(jax.random.PRNGKey(2))
+        base = self._loss(GPTConfig(**kw), master, tokens, labels)
+        flash = self._loss(GPTConfig(**kw, use_flash_attention=True),
+                           master, tokens, labels)
+        remat = self._loss(GPTConfig(**kw, use_flash_attention=True,
+                                     remat=True), master, tokens, labels)
+        np.testing.assert_allclose(flash, base, rtol=2e-5, atol=2e-6)
+        np.testing.assert_allclose(remat, base, rtol=2e-5, atol=2e-6)
+
+    def test_remat_grads_match(self):
+        kw = dict(num_layers=2, hidden_size=32, num_attention_heads=4,
+                  vocab_size=VOCAB, max_position_embeddings=SEQ, tp_size=1)
+        tokens = _tokens(jax.random.PRNGKey(1))
+        labels = _tokens(jax.random.PRNGKey(2))
+
+        def grads_for(cfg):
+            parallel_state.destroy_model_parallel()
+            mesh = parallel_state.initialize_model_parallel(1, 1)
+            model = GPTModel(cfg)
+            master = GPTModel(GPTConfig(**kw)).init_master(
+                jax.random.PRNGKey(0))
+            p = model.shard_master(master, 0)
+
+            def loss(p):
+                def run(p, t, l):
+                    return jnp.mean(model.apply(p, t, labels=l))
+                return shard_map(run, mesh=mesh, in_specs=(P(), P(), P()),
+                                 out_specs=P(), check_rep=False)(
+                    p, tokens, labels)
+
+            g = jax.grad(loss)(p)
+            parallel_state.destroy_model_parallel()
+            return g
+
+        g0 = grads_for(GPTConfig(**kw))
+        g1 = grads_for(GPTConfig(**kw, use_flash_attention=True, remat=True))
+        for a, b in zip(jax.tree_util.tree_leaves(g0),
+                        jax.tree_util.tree_leaves(g1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)

@@ -65,3 +65,28 @@ def test_bad_batch_split():
     mod = _load_main()
     with pytest.raises(ValueError):
         mod.main(TINY + ["--epochs", "1", "--n-devices", "3"])
+
+
+def test_native_record_backend(tmp_path, capsys):
+    """Train from packed record files through the C++ prefetching loader
+    (the reference's DALI data-backend path)."""
+    import numpy as np
+
+    from apex_tpu.data import native_available, write_records
+    if not native_available():
+        pytest.skip("native toolchain unavailable")
+
+    img, classes, n = 16, 10, 48
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (n, img * img * 3), dtype=np.int64)
+    labels = rng.integers(0, classes, (n,), dtype=np.int64)
+    recs = np.concatenate(
+        [imgs.astype(np.uint8),
+         labels.astype(np.int32).view(np.uint8).reshape(n, 4)], axis=1)
+    write_records(str(tmp_path / "train0.rec"), recs)
+
+    mod = _load_main()
+    state = mod.main(TINY + ["--epochs", "1", "--data", str(tmp_path)])
+    assert int(state.step) == 6
+    out = capsys.readouterr().out
+    assert "Prec@1" in out
