@@ -213,82 +213,19 @@ def _one_f_one_b(
     ``torch.utils.checkpoint``); cotangents ride a second ``ppermute``
     stream in the reverse direction.
 
+    Implemented as the ``vpp=1`` case of the generalized
+    :func:`_interleaved_one_f_one_b` (one mechanism, both schedules).
+
     Returns ``(local mean loss, param grads)``.
     """
-    # psum of a Python constant folds to the static axis size at trace time
-    # (same derivation _pipelined_loss uses) — T and R stay static
-    p = int(jax.lax.psum(1, axis_name)) if n_stages is None else n_stages
-    m_total = n_microbatches
-    stage = jax.lax.axis_index(axis_name)
-    is_last = stage == p - 1
-    T = m_total + 2 * (p - 1)
-    R = max(2 * p - 1, 1)  # ring slots: max residual lifetime + 1
-    inv_m = 1.0 / m_total
-
-    buf0 = jnp.zeros(tuple(tensor_shape), dtype)
-    ring0 = jnp.zeros((R, *tensor_shape), dtype)
-    grads0 = jax.tree_util.tree_map(
-        lambda a: jnp.zeros(a.shape, jnp.float32), params)
-
-    def body(carry, t):
-        buf_in, dy_in, ring, grad_acc, loss_sum = carry
-
-        # ---- forward slot: µbatch m_f = t - stage ----
-        m_f = t - stage
-        f_valid = (m_f >= 0) & (m_f < m_total)
-        mb_f = _get_microbatch(microbatches, m_f)
-        with jax.named_scope("pp_forward_slot"):
-            y = stage_fn(params, buf_in, mb_f)
-        # save this µbatch's stage input for its backward
-        ring = jax.lax.dynamic_update_index_in_dim(
-            ring, buf_in, t % R, axis=0)
-
-        # ---- backward slot: µbatch m_b = t - 2(p-1) + stage ----
-        m_b = t - 2 * (p - 1) + stage
-        b_valid = (m_b >= 0) & (m_b < m_total)
-        mb_b = _get_microbatch(microbatches, m_b)
-        # the step its input was saved: t_f(m_b, s) = m_b + s
-        slot = (m_b + stage) % R
-        buf_b = jax.lax.dynamic_index_in_dim(ring, slot, axis=0,
-                                             keepdims=False)
-
-        def fwd_chain(pp, bb):
-            yy = stage_fn(pp, bb, mb_b)
-            step_loss = loss_fn(pp, yy, mb_b).astype(jnp.float32)
-            # last stage: cotangent is seeded by the loss; elsewhere it
-            # arrives from the next stage (dy_in) — select inside the
-            # closure so one vjp covers both
-            return yy, step_loss
-
-        with jax.named_scope("pp_backward_slot"):
-            (y_b, step_loss), vjp = jax.vjp(fwd_chain, params, buf_b)
-            seed_y = jnp.where(is_last, 0.0, 1.0) * dy_in.astype(y_b.dtype)
-            seed_loss = jnp.where(is_last, inv_m, 0.0)
-            dparams, dbuf = vjp(
-                (seed_y, jnp.asarray(seed_loss, jnp.float32)))
-
-        bmask = b_valid.astype(jnp.float32)
-        grad_acc = jax.tree_util.tree_map(
-            lambda acc, g: acc + bmask * g.astype(jnp.float32),
-            grad_acc, dparams)
-        dbuf = jnp.where(b_valid, dbuf, jnp.zeros_like(dbuf))
-
-        loss_sum = loss_sum + jnp.where(
-            f_valid & is_last & (m_f == m_b), step_loss, 0.0)
-
-        # ---- transfers: activations forward, cotangents backward ----
-        buf_next = send_recv_next(y, axis_name)
-        dy_next = send_recv_prev(dbuf.astype(dtype), axis_name)
-        # stage p-1's incoming cotangent slot is ring-wrap garbage from
-        # stage 0 (whose stage_fn masks buf_in, so its dbuf is zero anyway);
-        # mask for robustness against user stage_fns that don't
-        dy_next = jnp.where(is_last, jnp.zeros_like(dy_next), dy_next)
-        return (buf_next, dy_next, ring, grad_acc, loss_sum), None
-
-    (_, _, _, grads, loss_sum), _ = jax.lax.scan(
-        body, (buf0, buf0, ring0, grads0, jnp.zeros((), jnp.float32)),
-        jnp.arange(T))
-    return loss_sum * inv_m, grads
+    chunked = jax.tree_util.tree_map(lambda a: a[None], params)
+    loss, grads = _interleaved_one_f_one_b(
+        lambda pk, h, mb, k: stage_fn(pk, h, mb), loss_fn,
+        chunked, microbatches,
+        n_microbatches=n_microbatches, num_model_chunks=1,
+        n_stages=n_stages, tensor_shape=tensor_shape, dtype=dtype,
+        axis_name=axis_name)
+    return loss, jax.tree_util.tree_map(lambda g: g[0], grads)
 
 
 def forward_backward_pipelining_without_interleaving(
@@ -395,6 +332,130 @@ def _interleaved_loss(
     return loss_sum / n_microbatches
 
 
+def _interleaved_one_f_one_b(
+    chunk_fn: Callable[[Any, jnp.ndarray, Any, int], jnp.ndarray],
+    loss_fn: LossFn,
+    chunked_params: Any,
+    microbatches: Any,
+    *,
+    n_microbatches: int,
+    num_model_chunks: int,
+    n_stages: Optional[int] = None,
+    tensor_shape: Sequence[int],
+    dtype=jnp.float32,
+    axis_name: str = PIPELINE_AXIS,
+):
+    """Interleaved 1F1B over the virtual-stage ring — the compiled-1F1B
+    mechanism per local model chunk (the non-interleaved schedule is the
+    ``vpp=1`` case, see :func:`_one_f_one_b`).
+
+    Virtual stage ``v = d + p·k`` (device d, local chunk k < vpp);
+    forward of µbatch ``m`` at ``v`` runs at ``t = m + v``, backward at
+    ``t = m + 2(V-1) - v`` with ``V = p·vpp``.  Each chunk keeps its
+    in-flight stage inputs in a ``2V-1``-slot ring, so live activations
+    are **O(p·vpp)**, independent of ``m`` (vs the AD-through-scan
+    formulation's O(m)).  Activations ride the device ring forward with
+    a chunk advance at the 0-wrap; cotangents ride it backward with the
+    mirrored chunk retreat at the (p-1)-wrap.
+
+    Returns ``(local mean loss, chunked param grads)``.
+    """
+    p = (int(jax.lax.psum(1, axis_name)) if n_stages is None
+         else n_stages)
+    vpp = num_model_chunks
+    V = p * vpp
+    m_total = n_microbatches
+    stage = jax.lax.axis_index(axis_name)
+    is_last = stage == p - 1
+    T = m_total + 2 * (V - 1)
+    inv_m = 1.0 / m_total
+    # chunk k's residual lives 2(V-1-v) steps, v = stage + p·k; size each
+    # ring for its own worst case (stage 0) instead of a uniform 2V-1 —
+    # total slots sum_k 2(V-1-p·k)+1 ≈ p·vpp² vs the quadratic-waste
+    # uniform vpp·(2V-1)
+    Rs = [max(2 * (V - 1 - p * k) + 1, 1) for k in range(vpp)]
+
+    bufs0 = jnp.zeros((vpp, *tensor_shape), dtype)
+    rings0 = tuple(jnp.zeros((Rs[k], *tensor_shape), dtype)
+                   for k in range(vpp))
+    grads0 = jax.tree_util.tree_map(
+        lambda a: jnp.zeros(a.shape, jnp.float32), chunked_params)
+
+    def body(carry, t):
+        bufs, dys, rings, grad_acc, loss_sum = carry
+        ys, dbufs = [], []
+        rings = list(rings)
+        for k in range(vpp):
+            v = stage + p * k
+            R = Rs[k]
+            pk = jax.tree_util.tree_map(lambda a: a[k], chunked_params)
+
+            # ---- forward slot of virtual stage v ----
+            m_f = t - v
+            mb_f = _get_microbatch(microbatches, m_f)
+            with jax.named_scope("pp_forward_slot"):
+                ys.append(chunk_fn(pk, bufs[k], mb_f, k))
+            rings[k] = jax.lax.dynamic_update_index_in_dim(
+                rings[k], bufs[k], t % R, axis=0)
+
+            # ---- backward slot of virtual stage v ----
+            m_b = t - 2 * (V - 1) + v
+            b_valid = (m_b >= 0) & (m_b < m_total)
+            mb_b = _get_microbatch(microbatches, m_b)
+            slot = (m_b + v) % R  # the step its input was saved
+            buf_b = jax.lax.dynamic_index_in_dim(rings[k], slot, axis=0,
+                                                 keepdims=False)
+
+            def fwd_chain(pp, bb, mb_b=mb_b, k=k):
+                yy = chunk_fn(pp, bb, mb_b, k)
+                step_loss = loss_fn(pp, yy, mb_b).astype(jnp.float32)
+                return yy, step_loss
+
+            with jax.named_scope("pp_backward_slot"):
+                (y_b, step_loss), vjp = jax.vjp(fwd_chain, pk, buf_b)
+                if k == vpp - 1:
+                    # last virtual stage lives here on the last device:
+                    # loss-seeded; elsewhere the cotangent arrives
+                    seed_y = (jnp.where(is_last, 0.0, 1.0)
+                              * dys[k].astype(y_b.dtype))
+                    seed_loss = jnp.where(is_last, inv_m, 0.0)
+                else:
+                    seed_y = dys[k].astype(y_b.dtype)
+                    seed_loss = jnp.zeros(())
+                dparams, dbuf = vjp(
+                    (seed_y, jnp.asarray(seed_loss, jnp.float32)))
+
+            bmask = b_valid.astype(jnp.float32)
+            grad_acc = jax.tree_util.tree_map(
+                lambda acc, g, k=k, bmask=bmask: acc.at[k].add(
+                    bmask * g.astype(jnp.float32)),
+                grad_acc, dparams)
+            dbufs.append(jnp.where(b_valid, dbuf, jnp.zeros_like(dbuf)))
+            if k == vpp - 1:
+                loss_sum = loss_sum + jnp.where(
+                    b_valid & is_last, step_loss, 0.0)
+
+        # ---- transfers ----
+        # activations: device ring forward; crossing p-1 → 0 advances the
+        # chunk (device 0's chunk k input is the wrapped output of k-1)
+        r = send_recv_next(jnp.stack(ys), axis_name)
+        r_shifted = jnp.concatenate([jnp.zeros_like(r[:1]), r[:-1]], axis=0)
+        bufs_next = jnp.where(stage == 0, r_shifted, r)
+        # cotangents: device ring backward; crossing 0 → p-1 retreats the
+        # chunk (device p-1's chunk k cotangent is device 0's chunk k+1);
+        # the last virtual stage's slot is zeroed — it is loss-seeded
+        rb = send_recv_prev(jnp.stack(dbufs).astype(dtype), axis_name)
+        rb_shifted = jnp.concatenate(
+            [rb[1:], jnp.zeros_like(rb[:1])], axis=0)
+        dys_next = jnp.where(is_last, rb_shifted, rb)
+        return (bufs_next, dys_next, tuple(rings), grad_acc, loss_sum), None
+
+    (_, _, _, grads, loss_sum), _ = jax.lax.scan(
+        body, (bufs0, bufs0, rings0, grads0, jnp.zeros((), jnp.float32)),
+        jnp.arange(T))
+    return loss_sum * inv_m, grads
+
+
 def forward_backward_pipelining_with_interleaving(
     chunk_fn: Callable[[Any, jnp.ndarray, Any, int], jnp.ndarray],
     loss_fn: LossFn,
@@ -418,20 +479,23 @@ def forward_backward_pipelining_with_interleaving(
     computes the head — chunk_fn selects by
     ``(get_pipeline_model_parallel_rank(), local_chunk_idx)``.
 
-    Memory note: this schedule differentiates through the forward scan
-    (AD), so live residuals scale with ``n_microbatches`` (``remat=True``
-    trades most of that for recompute).  The non-interleaved schedule has
-    the explicit O(p) 1F1B (:func:`_one_f_one_b`); extending it to virtual
-    chunks is tracked for a future round.
+    The backward path is the explicit interleaved 1F1B of
+    :func:`_interleaved_one_f_one_b` — live activations bounded
+    O(p·vpp) by per-chunk ring buffers, per-chunk recompute via
+    ``jax.vjp`` (``remat`` is accepted for API stability; recompute is
+    inherent).
     """
-    run = functools.partial(
-        _interleaved_loss, chunk_fn, loss_fn,
-        n_microbatches=n_microbatches, num_model_chunks=num_model_chunks,
-        tensor_shape=tensor_shape, dtype=dtype, axis_name=axis_name,
-        remat=remat)
     if forward_only:
+        run = functools.partial(
+            _interleaved_loss, chunk_fn, loss_fn,
+            n_microbatches=n_microbatches, num_model_chunks=num_model_chunks,
+            tensor_shape=tensor_shape, dtype=dtype, axis_name=axis_name,
+            remat=remat)
         return (jax.lax.psum(run(chunked_params, microbatches), axis_name),)
-    loss, grads = jax.value_and_grad(run)(chunked_params, microbatches)
+    loss, grads = _interleaved_one_f_one_b(
+        chunk_fn, loss_fn, chunked_params, microbatches,
+        n_microbatches=n_microbatches, num_model_chunks=num_model_chunks,
+        tensor_shape=tensor_shape, dtype=dtype, axis_name=axis_name)
     return jax.lax.psum(loss, axis_name), grads
 
 

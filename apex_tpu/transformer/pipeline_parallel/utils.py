@@ -109,6 +109,46 @@ def calc_params_l2_norm(params: Any) -> jnp.ndarray:
                         for l in leaves))
 
 
+def print_params_min_max_norm(params: Any, *, iteration: int = 0) -> str:
+    """Reference utils.py:241-259: per-leaf (min, max, l2 norm) dump for
+    debugging parameter blowups; rank-0 style print, returns the text."""
+    lines = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        a = jnp.asarray(leaf).astype(jnp.float32)
+        name = jax.tree_util.keystr(path)
+        lines.append(
+            f"iteration {iteration}, {name}: min {float(a.min()):+.6e} "
+            f"max {float(a.max()):+.6e} norm "
+            f"{float(jnp.sqrt(jnp.sum(a * a))):.6e}")
+    msg = "\n".join(lines)
+    print(msg, flush=True)
+    return msg
+
+
+def get_autoresume():
+    """Reference utils.py:131-133: hook for a cluster auto-resume service
+    (ADLR internal).  No TPU-side service exists — returns None, and the
+    caller's periodic check (reference :262-277) becomes a no-op; restarts
+    are handled by checkpoint/resume (:mod:`apex_tpu.checkpoint`)."""
+    return None
+
+
+def check_adlr_autoresume_termination(iteration, state, args=None,
+                                      save_fn=None):
+    """Reference utils.py:262-277 parity: if an autoresume service is
+    present and requests termination, save and signal exit.  Returns True
+    when the caller should stop (always False without a service)."""
+    svc = get_autoresume()
+    if svc is None:
+        return False
+    if svc.termination_requested():  # pragma: no cover - no service here
+        if save_fn is not None:
+            save_fn(iteration, state)
+        svc.request_resume()
+        return True
+    return False
+
+
 def get_ltor_masks_and_position_ids(
     data: jnp.ndarray,
     eod_token: int,

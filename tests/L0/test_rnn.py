@@ -158,3 +158,61 @@ def test_rnn_compat_half_cell():
     hy, cy = cell(params, x, h)
     assert hy.dtype == jnp.bfloat16
     assert cy.dtype == jnp.float32  # cell state carried fp32
+
+
+class TestAmpRnnCompat:
+    """amp ↔ RNN integration (reference apex/amp RNN compat shims,
+    amp/rnn_compat.py + VERDICT r1 row 10): with functional params, the O2
+    cast/master-weight path applies to RNNs with no special-casing — prove
+    it trains under amp O2 with a dynamic loss scale and skips on inf."""
+
+    def test_lstm_trains_under_amp_o2(self):
+        from apex_tpu import amp, optimizers
+        from apex_tpu.rnn import LSTM
+
+        model = LSTM(input_size=8, hidden_size=16, num_layers=2)
+        params = model.init(jax.random.PRNGKey(0))
+        amp_state = amp.initialize("O2")
+        scaler = amp_state.scaler
+        scale_state = scaler.init()
+        opt = optimizers.FusedAdam(lr=1e-2)
+        opt_state = opt.init(params)
+
+        x = jax.random.normal(jax.random.PRNGKey(1), (6, 4, 8))
+        y = jax.random.normal(jax.random.PRNGKey(2), (6, 4, 16))
+
+        def loss_fn(p, x, y):
+            out, _ = model.apply(p, x, training=False)
+            return jnp.mean((out - y) ** 2)
+
+        grad_fn = amp.scaled_value_and_grad(loss_fn, scaler)
+
+        @jax.jit
+        def step(params, opt_state, scale_state, x, y):
+            half = amp_state.cast_model(params)
+            loss, grads, finite = grad_fn(scale_state, half, x, y)
+            new_p, new_o = opt.step(grads, opt_state, params)
+            params, opt_state = amp.skip_or_step(
+                finite, (new_p, new_o), (params, opt_state))
+            return params, opt_state, scaler.update(scale_state, finite), loss
+
+        losses = []
+        for _ in range(20):
+            params, opt_state, scale_state, loss = step(
+                params, opt_state, scale_state, x, y)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.9, losses
+
+        # the compute params really are half precision under O2
+        half = amp_state.cast_model(params)
+        dtypes = {a.dtype for a in jax.tree_util.tree_leaves(half)}
+        assert jnp.dtype(jnp.bfloat16) in dtypes or jnp.dtype(jnp.float16) in dtypes
+
+        # a poisoned batch skips the step and halves the scale
+        before = jax.tree_util.tree_leaves(params)[0]
+        scale_before = scale_state.loss_scale
+        params2, _, scale_state2, _ = step(
+            params, opt_state, scale_state, jnp.full_like(x, jnp.inf), y)
+        np.testing.assert_array_equal(
+            jax.tree_util.tree_leaves(params2)[0], before)
+        assert float(scale_state2.loss_scale) < float(scale_before)
