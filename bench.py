@@ -34,7 +34,7 @@ from apex_tpu import amp, optimizers, profiling
 from apex_tpu.models import ResNet, resnet50_config
 from apex_tpu.ops import softmax_cross_entropy_loss
 
-BATCH = int(os.environ.get("BENCH_BATCH", "64"))
+BATCH = int(os.environ.get("BENCH_BATCH", "128"))
 IMG = 224
 STEPS = int(os.environ.get("BENCH_STEPS", "20"))
 FAST = os.environ.get("BENCH_FAST", "0") == "1"
@@ -101,19 +101,19 @@ def bench_resnet():
                           jnp.bfloat16)
     y = jax.random.randint(jax.random.PRNGKey(2), (BATCH,), 0, 1000)
 
-    # compile ONCE; the compiled executable serves both the cost model
-    # (exact per-step flops, pyprof-parity path) and execution
-    compiled = train_step.lower(
-        params, bn_state, opt_state, scale_state, x, y).compile()
-    step_flops = profiling.cost_report_from_compiled(compiled).flops
-
-    params, bn_state, opt_state, scale_state, loss = compiled(
+    # warm the jit fastpath first (its dispatch is leaner than calling the
+    # AOT Compiled object), then read flops from an explicit lower+compile
+    # — the persistent XLA compile cache dedupes the second compilation
+    params, bn_state, opt_state, scale_state, loss = train_step(
         params, bn_state, opt_state, scale_state, x, y)
     float(loss)
+    step_flops = profiling.cost_report_from_compiled(
+        train_step.lower(params, bn_state, opt_state, scale_state,
+                         x, y).compile()).flops
 
     t0 = time.perf_counter()
     for _ in range(STEPS):
-        params, bn_state, opt_state, scale_state, loss = compiled(
+        params, bn_state, opt_state, scale_state, loss = train_step(
             params, bn_state, opt_state, scale_state, x, y)
     final_loss = float(loss)
     dt = time.perf_counter() - t0
@@ -163,16 +163,15 @@ def bench_gpt350m():
         p, opt_state = opt.step(grads, opt_state, p)
         return p, opt_state, loss
 
-    compiled = train_step.lower(params, opt_state, tokens, labels).compile()
-    step_flops = profiling.cost_report_from_compiled(compiled).flops
-
     steps = 8
-    params, opt_state, loss = compiled(params, opt_state, tokens, labels)
+    params, opt_state, loss = train_step(params, opt_state, tokens, labels)
     float(loss)
+    step_flops = profiling.cost_report_from_compiled(
+        train_step.lower(params, opt_state, tokens, labels).compile()).flops
     t0 = time.perf_counter()
     for _ in range(steps):
-        params, opt_state, loss = compiled(params, opt_state, tokens,
-                                           labels)
+        params, opt_state, loss = train_step(params, opt_state, tokens,
+                                             labels)
     final = float(loss)
     dt = time.perf_counter() - t0
     parallel_state.destroy_model_parallel()
@@ -181,10 +180,14 @@ def bench_gpt350m():
 
 
 def bench_attention_kernel():
-    """Pallas flash attention vs XLA naive (fwd, causal, bf16): speedup."""
+    """Pallas flash attention vs XLA naive (fwd, causal, bf16): speedup.
+
+    s=4096 where the S×S materialization hurts naive structurally — the
+    relative number is stable across chip-state variance (absolute TFLOPS
+    over the relay are not)."""
     from apex_tpu.ops.attention import flash_attention
 
-    bh, s, d = 16, 2048, 128
+    bh, s, d = 16, 4096, 128
     k = jax.random.normal(jax.random.PRNGKey(1), (bh, s, d), jnp.bfloat16)
     v = jax.random.normal(jax.random.PRNGKey(2), (bh, s, d), jnp.bfloat16)
     q = jax.random.normal(jax.random.PRNGKey(0), (bh, s, d), jnp.bfloat16)
@@ -198,8 +201,8 @@ def bench_attention_kernel():
             jnp.bfloat16)
 
     t_pallas = _bench_scan(lambda x: flash_attention(x, k, v, causal=True),
-                           q, 20)
-    t_naive = _bench_scan(naive, q, 20)
+                           q, 12)
+    t_naive = _bench_scan(naive, q, 12)
     flops = 2 * 2 * bh * s * s * d / 2
     return {
         "pallas_tflops": round(flops / t_pallas / 1e12, 2),
