@@ -70,6 +70,11 @@ class GPTConfig:
     # probs materialised) and rematerialise each layer in backward
     use_flash_attention: bool = False
     remat: bool = False
+    # flash kernel tile sizes (512² measured best for fwd+bwd at the
+    # GPT-350M shape bh=128 s=1024 d=64; the 512/1024 library defaults
+    # favor long sequences)
+    flash_block_q: int = 512
+    flash_block_k: int = 512
 
     @property
     def ffn(self) -> int:
@@ -136,7 +141,9 @@ class ParallelAttention:
             qh = q.transpose(0, 2, 1, 3)  # [b, np, s, hn]
             kh = k.transpose(0, 2, 1, 3)
             vh = v.transpose(0, 2, 1, 3)
-            ctx = flash_attention(qh, kh, vh, causal=True)
+            ctx = flash_attention(qh, kh, vh, causal=True,
+                                  block_q=cfg.flash_block_q,
+                                  block_k=cfg.flash_block_k)
             ctx = ctx.transpose(0, 2, 1, 3).reshape(
                 b, s, self.np_local * cfg.kv_channels).astype(h.dtype)
             return self.proj.apply(params["proj"], ctx)
