@@ -69,6 +69,8 @@ def _build() -> ctypes.CDLL:
     lib.axl_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     lib.axl_num_records.restype = ctypes.c_int64
     lib.axl_num_records.argtypes = [ctypes.c_void_p]
+    lib.axl_error_count.restype = ctypes.c_int64
+    lib.axl_error_count.argtypes = [ctypes.c_void_p]
     lib.axl_close.restype = None
     lib.axl_close.argtypes = [ctypes.c_void_p]
     return lib

@@ -15,6 +15,7 @@
 #include <fcntl.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -27,6 +28,10 @@
 namespace {
 
 struct Loader {
+  // lifetime count of records that failed to read (truncated / rotated
+  // files); those records are zero-filled, and the consumer must be able
+  // to see that it happened — silent corruption is worse than a crash
+  std::atomic<int64_t> read_errors{0};
   std::vector<int> fds;
   std::vector<int64_t> file_base;  // cumulative record start per file
   int64_t total_records = 0;
@@ -113,9 +118,11 @@ struct Loader {
       char* buf = ring[static_cast<size_t>(slot)].data();
       for (int64_t i = 0; i < batch; ++i) {
         if (!read_record(recs[static_cast<size_t>(i)],
-                         buf + i * record_bytes))
+                         buf + i * record_bytes)) {
           std::memset(buf + i * record_bytes, 0,
                       static_cast<size_t>(record_bytes));
+          read_errors.fetch_add(1, std::memory_order_relaxed);
+        }
       }
       {
         std::lock_guard<std::mutex> lk(mu);
@@ -205,6 +212,14 @@ int axl_next(void* h, char* out) {
   }
   L->cv_free.notify_all();
   return 0;
+}
+
+// Count of records zero-filled because pread failed (IO error surface —
+// poll after axl_next; nonzero means the epoch's data is suspect).
+int64_t axl_error_count(void* h) {
+  if (!h) return -1;
+  return static_cast<Loader*>(h)->read_errors.load(
+      std::memory_order_relaxed);
 }
 
 void axl_close(void* h) {

@@ -59,6 +59,26 @@ def _keystr(path) -> str:
     return jax.tree_util.keystr(path)
 
 
+def _path_parts(path) -> list:
+    """Structured path components (dict keys / attr names / indices as
+    strings) — stored in the manifest so ``target=None`` restore does not
+    have to re-parse ``keystr`` output (which mangles keys containing
+    quotes or brackets)."""
+    parts = []
+    for e in path:
+        if isinstance(e, jax.tree_util.DictKey):
+            parts.append(str(e.key))
+        elif isinstance(e, jax.tree_util.SequenceKey):
+            parts.append(str(e.idx))
+        elif isinstance(e, jax.tree_util.GetAttrKey):
+            parts.append(str(e.name))
+        elif isinstance(e, jax.tree_util.FlattenedIndexKey):
+            parts.append(str(e.key))
+        else:  # unknown key type: best-effort string
+            parts.append(str(e))
+    return parts
+
+
 def _is_spec_leaf(x) -> bool:
     return x is None or isinstance(x, (PartitionSpec, NamedSharding))
 
@@ -182,8 +202,17 @@ def save_checkpoint(
         # empty subtree, so None-valued fields are simply absent and
         # reappear from the target's structure on restore)
         key = _keystr(path)
+        # keystr can collide for keys containing quotes/brackets; the
+        # structured "path" is the identity — disambiguate the flat key
+        # (it is only a storage label once "path" exists)
+        if key in manifest["leaves"]:
+            i = 2
+            while f"{key}#{i}" in manifest["leaves"]:
+                i += 1
+            key = f"{key}#{i}"
         val = np.asarray(jax.device_get(leaf))
-        entry = {"kind": "array", "dtype": str(val.dtype), "shape": list(val.shape)}
+        entry = {"kind": "array", "dtype": str(val.dtype),
+                 "shape": list(val.shape), "path": _path_parts(path)}
         if str(val.dtype) in _HALF_DTYPES:
             if fp32_portable:
                 val = val.astype(np.float32)
@@ -323,15 +352,25 @@ def restore_checkpoint(
         return arr
 
     if target is None:
-        out = {}
+        nested: dict = {}
         for key, entry in manifest["leaves"].items():
-            out[key] = _materialize(key, entry)
-        return _nest(out), step
+            # manifests carry structured path components (format >= 1 with
+            # "path"); older ones fall back to parsing the keystr
+            parts = entry.get("path") or _parse_keystr(key)
+            node = nested
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = _materialize(key, entry)
+        return nested, step
 
     paths, treedef = jax.tree_util.tree_flatten_with_path(target)
+    # primary lookup by structured path (collision-free); keystr is the
+    # fallback for manifests written before the "path" field existed
+    by_path = {tuple(e["path"]): k for k, e in manifest["leaves"].items()
+               if "path" in e}
     leaves = []
     for path, tleaf in paths:
-        key = _keystr(path)
+        key = by_path.get(tuple(_path_parts(path)), _keystr(path))
         if key not in manifest["leaves"]:
             raise KeyError(f"checkpoint at {d} is missing leaf {key}")
         want = None
@@ -352,18 +391,13 @@ def _filter_spec_entry(part, mesh: Mesh):
     return part if part in mesh.axis_names else None
 
 
-def _nest(flat: dict) -> dict:
-    """Rebuild a nested dict from keystr paths like ``['a'][0].b``."""
+def _parse_keystr(key: str) -> list:
+    """Back-compat path recovery for manifests without structured "path"
+    entries: parse ``['a'][0].b`` keystrs.  Best-effort — keys containing
+    quotes/brackets need the structured form."""
     import re
 
-    out: dict = {}
     token = re.compile(r"\[\'([^\']*)\'\]|\[(\d+)\]|\.([A-Za-z_][A-Za-z_0-9]*)")
-    for key, val in flat.items():
-        parts = [m.group(1) or m.group(2) or m.group(3) for m in token.finditer(key)]
-        if not parts:
-            parts = [key]
-        node = out
-        for p in parts[:-1]:
-            node = node.setdefault(p, {})
-        node[parts[-1]] = val
-    return out
+    parts = [m.group(1) or m.group(2) or m.group(3)
+             for m in token.finditer(key)]
+    return parts or [key]

@@ -80,6 +80,13 @@ class NativeRecordLoader:
     def batches_per_epoch(self) -> int:
         return self.num_records // self.batch_size
 
+    @property
+    def error_count(self) -> int:
+        """Records zero-filled because a read failed (truncated/rotated
+        file).  Nonzero means delivered data is suspect — check after
+        each epoch (or each batch for strict pipelines)."""
+        return int(self._lib.axl_error_count(self._h)) if self._h else 0
+
     def next_batch(self) -> object:
         out = np.empty((self.batch_size, self.record_bytes), np.uint8)
         rc = self._lib.axl_next(self._h, ctypes.c_void_p(out.ctypes.data))

@@ -564,18 +564,24 @@ def flash_attention(
     scale: Optional[float] = None,
     block_q: int = 512,
     block_k: int = 1024,
+    mask_is_constant: bool = True,
 ) -> jnp.ndarray:
     """Fused attention over [b, h, s, d] (or [bh, s, d]) tensors.
 
     Drop-in for the reference's ``fmha.FMHAFun`` (fmha.py:33) and the core
     of every ``fast_*_multihead_attn`` — without its seq-len/head-dim
     restrictions.  ``mask_bias`` is an *additive* mask (the
-    additive-mask-softmax variants), treated as constant under
-    differentiation; boolean masks should be converted with
-    ``jnp.where(mask, -10000.0, 0.0)``.  ``segment_ids`` masks attention
-    across segment boundaries (varlen packing): an int array [s] or
-    [b, s] for self-attention, or a ``(seg_q, seg_k)`` pair for
-    cross-length cases.
+    additive-mask-softmax variants); boolean masks should be converted
+    with ``jnp.where(mask, -10000.0, 0.0)``.  By default it is treated as
+    a constant under differentiation (the reference's masks encode
+    padding, never parameters) — pass ``mask_is_constant=False`` for a
+    *trainable* additive bias (learned ALiBi/relative-position style):
+    that routes through a plain differentiable XLA path (materialises the
+    S×S scores; the Pallas kernels do not emit a mask gradient) so AD
+    produces the bias gradient instead of silent zeros.  ``segment_ids``
+    masks attention across segment boundaries (varlen packing): an int
+    array [s] or [b, s] for self-attention, or a ``(seg_q, seg_k)`` pair
+    for cross-length cases.
     """
     squeeze = False
     seg_q = seg_k = None
@@ -604,11 +610,17 @@ def flash_attention(
         squeeze = (b, h)
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    if mask_bias is not None:
-        mask_bias = jax.lax.stop_gradient(mask_bias)
-    o = _flash_attention(q, k, v, mask_bias, seg_q, seg_k,
-                         float(scale), bool(causal),
-                         int(block_q), int(block_k))
+    if mask_bias is not None and not mask_is_constant:
+        # differentiable-bias path: same math, no custom_vjp, so AD
+        # derives d(mask_bias) — the kernels only handle constant masks
+        o, _ = _blockwise_fwd_xla(q, k, v, float(scale), bool(causal),
+                                  mask_bias, seg_q, seg_k)
+    else:
+        if mask_bias is not None:
+            mask_bias = jax.lax.stop_gradient(mask_bias)
+        o = _flash_attention(q, k, v, mask_bias, seg_q, seg_k,
+                             float(scale), bool(causal),
+                             int(block_q), int(block_k))
     if squeeze:
         b, h = squeeze
         o = o.reshape(b, h, o.shape[1], o.shape[2])

@@ -44,7 +44,18 @@ __all__ = [
     "cost_report_from_compiled",
     "format_cost_report",
     "CostReport",
+    "OpTime",
+    "parse_trace_dir",
+    "top_ops_report",
+    "format_top_ops",
 ]
+
+from apex_tpu.profiling.trace_report import (  # noqa: E402
+    OpTime,
+    format_top_ops,
+    parse_trace_dir,
+    top_ops_report,
+)
 
 
 # ---------------------------------------------------------------------------

@@ -425,10 +425,11 @@ def _interleaved_one_f_one_b(
                 dparams, dbuf = vjp(
                     (seed_y, jnp.asarray(seed_loss, jnp.float32)))
 
-            bmask = b_valid.astype(jnp.float32)
+            # where-mask (not multiply): a vjp on stale ring-buffer inputs
+            # may yield inf/nan, and 0*nan would poison the accumulator
             grad_acc = jax.tree_util.tree_map(
-                lambda acc, g, k=k, bmask=bmask: acc.at[k].add(
-                    bmask * g.astype(jnp.float32)),
+                lambda acc, g, k=k, b_valid=b_valid: acc.at[k].add(
+                    jnp.where(b_valid, g.astype(jnp.float32), 0.0)),
                 grad_acc, dparams)
             dbufs.append(jnp.where(b_valid, dbuf, jnp.zeros_like(dbuf)))
             if k == vpp - 1:

@@ -100,6 +100,34 @@ def model_parallel_cuda_manual_seed(seed: int, tp_rank=None) -> None:
 model_parallel_seed = model_parallel_cuda_manual_seed
 
 
+def model_parallel_dropout_key(key: jax.Array,
+                               axis_name: str = TENSOR_AXIS) -> jax.Array:
+    """Per-TP-rank dropout key from a replicated base key — the
+    ``get_cuda_rng_tracker().fork()`` discipline (reference random.py:
+    193-221: model-parallel seed = seed + 2718 + tp_rank): activations
+    *sharded* over TP (attention probs, 4h MLP activations) must drop
+    different elements per rank.  Outside any ``axis_name`` binding the
+    rank folds in as 0 (single-rank)."""
+    key = jax.random.fold_in(key, 2718)
+    try:
+        rank = jax.lax.axis_index(axis_name)
+    except NameError:
+        rank = 0
+    return jax.random.fold_in(key, rank)
+
+
+def dropout(x: jnp.ndarray, rate: float, key: jax.Array) -> jnp.ndarray:
+    """Inverted dropout (train-mode): zero with prob ``rate``, scale kept
+    elements by 1/(1-rate).  Callers choose the key stream: the *base*
+    (replicated) key for TP-replicated activations, or
+    :func:`model_parallel_dropout_key` for TP-sharded ones — that split is
+    the whole point of the reference's RNG tracker."""
+    if rate <= 0.0:
+        return x
+    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
 def checkpoint(function, *args, policy=None):
     """Activation checkpointing (reference CheckpointFunction random.py:224 +
     ``checkpoint`` :291): recompute ``function`` in the backward pass.

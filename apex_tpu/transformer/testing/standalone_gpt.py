@@ -48,6 +48,10 @@ from apex_tpu.transformer.tensor_parallel import (
     VocabParallelEmbedding,
     vocab_parallel_cross_entropy,
 )
+from apex_tpu.transformer.tensor_parallel.random import (
+    dropout as _dropout,
+    model_parallel_dropout_key,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -65,11 +69,23 @@ class GPTConfig:
     fp16: bool = False
     bf16: bool = False
     tp_size: int = 1
+    # dropout (reference ParallelAttention :283 / ParallelMLP-consumer
+    # bias_dropout_add :575 / Embedding dropout): active only when a
+    # ``dropout_key`` is passed to ``apply`` (training mode); parity and
+    # eval runs simply pass no key
+    attention_dropout: float = 0.0
+    hidden_dropout: float = 0.0
     # TPU-first extensions beyond the reference's arguments set:
     # use the Pallas flash kernel for causal self-attention (no S×S
     # probs materialised) and rematerialise each layer in backward
     use_flash_attention: bool = False
     remat: bool = False
+    # what the per-layer checkpoint saves: "full" recomputes the whole
+    # layer (max memory savings, ~33% extra flops); "dots" saves matmul
+    # outputs and recomputes only the cheap pointwise ops
+    # (jax.checkpoint_policies.dots_saveable) — near-zero recompute
+    # flops at ~4× the activation footprint of "full"
+    remat_policy: str = "full"
     # flash kernel tile sizes (512² measured best for fwd+bwd at the
     # GPT-350M shape bh=128 s=1024 d=64; the 512/1024 library defaults
     # favor long sequences)
@@ -126,14 +142,15 @@ class ParallelAttention:
         return {"qkv": self.qkv.shard_master(master["qkv"], rank),
                 "proj": self.proj.shard_master(master["proj"], rank)}
 
-    def apply(self, params, h, attention_mask=None):
+    def apply(self, params, h, attention_mask=None, dropout_key=None):
         # h: [b, s, hidden]
         cfg = self.cfg
+        do_dropout = dropout_key is not None and cfg.attention_dropout > 0.0
         b, s, _ = h.shape
         qkv = self.qkv.apply(params["qkv"], h)  # [b, s, 3*hidden/tp]
         qkv = qkv.reshape(b, s, self.np_local, 3 * cfg.kv_channels)
         q, k, v = jnp.split(qkv, 3, axis=-1)  # each [b, s, np, hn]
-        if cfg.use_flash_attention and attention_mask is None:
+        if cfg.use_flash_attention and attention_mask is None and not do_dropout:
             # Pallas flash kernel, causal (the model's mask type): heads
             # fold into the batch dim, no S×S probs in HBM
             from apex_tpu.ops.attention import flash_attention
@@ -153,6 +170,11 @@ class ParallelAttention:
                             preferred_element_type=jnp.float32)
         scores = (scores * scale).astype(h.dtype)
         probs = self.softmax(scores, attention_mask)
+        if do_dropout:
+            # probs are head-sharded over TP: per-rank stream (reference
+            # wraps this dropout in get_cuda_rng_tracker().fork(), :283)
+            probs = _dropout(probs, cfg.attention_dropout,
+                             model_parallel_dropout_key(dropout_key))
         ctx = jnp.einsum("bnqk,bknh->bqnh", probs, v,
                          preferred_element_type=jnp.float32).astype(h.dtype)
         ctx = ctx.reshape(b, s, self.np_local * cfg.kv_channels)
@@ -190,6 +212,16 @@ class ParallelMLP:
         return self.dense_4h_to_h.apply(params["dense_4h_to_h"], inter)
 
 
+def _hidden_dropout(x, cfg, key):
+    """Post-RowParallel hidden dropout: the activation is TP-replicated, so
+    the *base* (replicated) key is correct — every rank must drop the same
+    elements or the replicas diverge (reference bias_dropout_add :575 runs
+    on the default RNG stream)."""
+    if key is None or cfg.hidden_dropout <= 0.0:
+        return x
+    return _dropout(x, cfg.hidden_dropout, key)
+
+
 class ParallelTransformerLayer:
     """Pre-LN block (reference standalone_gpt.py:575-709)."""
 
@@ -217,14 +249,22 @@ class ParallelTransformerLayer:
             "mlp": self.mlp.shard_master(master["mlp"], rank),
         }
 
-    def apply(self, params, h, attention_mask=None):
-        eps = self.cfg.layernorm_epsilon
+    def apply(self, params, h, attention_mask=None, dropout_key=None):
+        cfg = self.cfg
+        eps = cfg.layernorm_epsilon
+        k_attn = k_h1 = k_h2 = None
+        if dropout_key is not None:
+            k_attn, k_h1, k_h2 = (jax.random.fold_in(dropout_key, i)
+                                  for i in range(3))
         ln1 = layer_norm(h, params["input_layernorm"]["weight"],
                          params["input_layernorm"]["bias"], eps=eps)
-        h = h + self.attention.apply(params["attention"], ln1, attention_mask)
+        attn = self.attention.apply(params["attention"], ln1, attention_mask,
+                                    dropout_key=k_attn)
+        h = h + _hidden_dropout(attn, cfg, k_h1)
         ln2 = layer_norm(h, params["post_attention_layernorm"]["weight"],
                          params["post_attention_layernorm"]["bias"], eps=eps)
-        return h + self.mlp.apply(params["mlp"], ln2)
+        return h + _hidden_dropout(self.mlp.apply(params["mlp"], ln2),
+                                   cfg, k_h2)
 
 
 class ParallelTransformer:
@@ -253,16 +293,28 @@ class ParallelTransformer:
 
         return {"layers": shard(master["layers"])}
 
-    def apply(self, params, h, attention_mask=None):
-        def body(carry, layer_params):
-            return self.layer.apply(layer_params, carry, attention_mask), None
+    def apply(self, params, h, attention_mask=None, dropout_key=None):
+        def body(carry, xs):
+            layer_params, idx = xs
+            k = (None if dropout_key is None
+                 else jax.random.fold_in(dropout_key, idx))
+            return self.layer.apply(layer_params, carry, attention_mask,
+                                    dropout_key=k), None
 
         if self.cfg.remat:
             # save only layer boundaries; recompute inside each layer on
             # backward (reference activation checkpointing, random.py TPU
-            # mapping) — activation memory O(L·B·S·H) → O(B·S·H)
-            body = jax.checkpoint(body)
-        h, _ = jax.lax.scan(body, h, params["layers"])
+            # mapping) — activation memory O(L·B·S·H) → O(B·S·H).  RNG
+            # replay on recompute is free: keys are values (fold_in of the
+            # same inputs), the property the reference's CheckpointFunction
+            # restores CUDA RNG state for.  remat_policy="dots" keeps the
+            # memory ceiling but skips recomputing the matmuls (the flops).
+            policy = (jax.checkpoint_policies.dots_saveable
+                      if self.cfg.remat_policy == "dots" else None)
+            body = jax.checkpoint(body, policy=policy)
+        h, _ = jax.lax.scan(body, h,
+                            (params["layers"],
+                             jnp.arange(self.num_layers)))
         return h
 
 
@@ -325,12 +377,22 @@ class GPTModel:
             h, w, (((h.ndim - 1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    def apply(self, params, tokens, labels=None, attention_mask=None):
+    def apply(self, params, tokens, labels=None, attention_mask=None,
+              dropout_key=None):
         """Full forward.  With ``labels`` returns per-token losses
         (reference GPTModel.forward returning CE loss); otherwise sharded
-        logits."""
+        logits.  ``dropout_key`` switches the config's
+        attention/hidden-dropout rates on (training mode); the key must be
+        TP-replicated — per-rank streams are derived inside (reference RNG
+        tracker discipline, random.py:193-221)."""
         h = self.embed(params, tokens)
-        h = self.transformer.apply(params["transformer"], h, attention_mask)
+        if dropout_key is not None and self.cfg.hidden_dropout > 0.0:
+            # embedding dropout (reference Embedding.forward applies
+            # hidden_dropout before the first layer)
+            h = _dropout(h, self.cfg.hidden_dropout,
+                         jax.random.fold_in(dropout_key, 0x0E0B))
+        h = self.transformer.apply(params["transformer"], h, attention_mask,
+                                   dropout_key=dropout_key)
         logits_local = self.head_logits_local(params, h)
         if labels is None:
             return logits_local

@@ -1,26 +1,33 @@
 #!/usr/bin/env python
-"""Benchmark driver — single-chip TPU throughput with MFU accounting.
+"""Benchmark driver — single-chip TPU throughput with honest MFU accounting.
 
 Headline (BASELINE.md config #1): ResNet-50, amp O2 (bf16 compute, fp32
 master weights, dynamic loss scale), FusedLAMB, synthetic ImageNet batch —
 the throughput the reference's examples/imagenet/main_amp.py prints per
 iteration (:361-376).
 
-Also measured every run (VERDICT r1 item 9):
-- the chip's *achievable* matmul roof (scan-amortized bf16 4096³), so MFU
-  is reported against measured reality, not a datasheet;
-- Megatron GPT-2 350M-class single-chip tokens/sec (BASELINE.md config #5,
-  apex/transformer/testing/standalone_gpt.py shapes);
-- kernel microbenches: Pallas flash attention and Pallas LayerNorm vs the
-  naive XLA formulations (each must win to keep its kernel path).
+Measurement methodology (reworked in r3 after the r2 numbers proved
+artifacts — VERDICT r2 weak #3/#4 + items 4/9):
+
+* The relay platform adds a large, *variable* per-dispatch and
+  per-scan-iteration overhead (measured ~2-3 ms floor, with whole-process
+  slow phases 5-10× worse).  Microbenches therefore time by **slope**:
+  run a scan whose body applies the op K_lo and K_hi times and divide the
+  time difference by (K_hi-K_lo)·n — fixed costs cancel exactly.
+* The matmul roof uses 8192³ (big enough that compute dwarfs any floor)
+  and takes the best of several trials: the demonstrated capability of
+  the chip, not the average of its contention states.
+* MFU is computed from **analytic model flops** (6·N per token for GPT,
+  ~3× single-pass conv flops for RN50 fwd+bwd), NOT from XLA cost
+  analysis: cost analysis can't see inside Pallas custom calls
+  (undercounts) and counts remat recompute (overcounts the model).  Both
+  numbers are still reported side by side in extras.
+* Every Pallas kernel must beat its XLA formulation at a
+  bandwidth-honest working-set size to keep its default ("win or fall
+  back") — the per-kernel microbenches below are the enforcement record.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extras"}.
-``vs_baseline`` compares against the previous round's recorded number in
-BASELINE.json["measured"].
-
-Platform note: axon's ``block_until_ready`` returns before execution
-completes — all timings here sync with a value fetch, and microbenches run
-inside a ``lax.scan`` so one dispatch amortizes the ~5 ms relay round-trip.
+``vs_baseline`` compares against BASELINE.json["measured"].
 """
 
 import json
@@ -41,36 +48,115 @@ FAST = os.environ.get("BENCH_FAST", "0") == "1"
 
 
 def _fetch(x):
-    """Hard sync: device-to-host value fetch."""
+    """Hard sync: device-to-host value fetch (the relay's
+    block_until_ready returns early; a value fetch cannot)."""
     return float(jnp.sum(x.astype(jnp.float32)))
 
 
-def _bench_scan(step_fn, init, n):
-    """Time n data-dependent iterations inside ONE compiled dispatch."""
+def _time_slope(op, x, *, lo=1, hi=5, n=6, trials=5):
+    """Seconds per application of ``op`` with fixed dispatch/iteration
+    overheads cancelled AND contention rejected: time(scan of n iters
+    doing K ops each) is sampled ``trials`` times interleaved for K=lo
+    and K=hi; the slope is computed from the per-K *minima*
+    (min(t_hi) - min(t_lo)) / ((hi-lo)*n).  The relay's contention noise
+    only ever adds time, so minima are mutually consistent — a plain
+    per-pair slope can even go negative when the chip speed shifts
+    between the two samples.
 
-    @jax.jit
-    def run(x):
-        out, _ = jax.lax.scan(lambda c, _: (step_fn(c), None), x, None,
-                              length=n)
-        return out
+    ``op`` must map a value to a like-shaped value (data-dependent
+    chaining keeps applications sequential on device)."""
 
-    _fetch(run(init))  # compile + warm
-    t0 = time.perf_counter()
-    _fetch(run(init))
-    return (time.perf_counter() - t0) / n
+    def make(k):
+        @jax.jit
+        def run(v):
+            def body(c, _):
+                for _ in range(k):
+                    c = op(c)
+                return c, None
+            out, _ = jax.lax.scan(body, v, None, length=n)
+            return out
+        return run
+
+    run_lo, run_hi = make(lo), make(hi)
+    _fetch(run_lo(x))
+    _fetch(run_hi(x))
+    t_lo = t_hi = float("inf")
+    for round_ in range(2):
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            _fetch(run_lo(x))
+            t_lo = min(t_lo, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            _fetch(run_hi(x))
+            t_hi = min(t_hi, time.perf_counter() - t0)
+        if t_hi > t_lo:
+            return (t_hi - t_lo) / ((hi - lo) * n)
+        # degenerate slope (a slow phase swallowed every hi sample):
+        # sample once more, then fall back below rather than clamp
+    # conservative fallback: absolute time of the hi run INCLUDING all
+    # fixed overheads — an upper bound on per-op time, so the derived
+    # throughput is a lower bound (noise can only make us look slower,
+    # never absurdly faster; a 1e-12 clamp here once produced
+    # quadrillion-TFLOPS entries in the record)
+    return t_hi / (hi * n)
 
 
 def bench_matmul_roof():
-    """Measured bf16 matmul ceiling (TFLOPS) — the denominator for MFU."""
-    n = 4096
-    a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
-    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16)
-    t = _bench_scan(lambda x: (x @ b).astype(jnp.bfloat16), a, 30)
-    return 2 * n ** 3 / t / 1e12
+    """Demonstrated bf16 matmul ceiling (TFLOPS) — the MFU denominator.
+
+    8192³ so compute (~1.1 TFLOP/iter) dwarfs the relay floor; best of
+    trials because the relay has whole-process slow phases."""
+    m = 8192
+    a = jax.random.normal(jax.random.PRNGKey(0), (m, m), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(1), (m, m), jnp.bfloat16)
+    t = _time_slope(lambda x: (x @ b).astype(jnp.bfloat16), a,
+                    lo=1, hi=3, n=8, trials=3)
+    return 2 * m ** 3 / t / 1e12
+
+
+def bench_hbm_roof():
+    """Demonstrated HBM streaming bandwidth (GB/s) — denominator for the
+    bandwidth-bound kernel microbenches.
+
+    The chained op is a Pallas identity-copy kernel: XLA loop-fuses any
+    chain of *its own* elementwise ops into one read+write (a tanh or
+    v+1 chain measures VPU, not HBM), but custom calls are opaque — K
+    chained copies are K real reads + K real writes, so traffic scales
+    with K and the slope isolates bandwidth."""
+    from jax.experimental import pallas as pl
+
+    rows, cols = 16384, 8192  # 512 MB fp32
+    x = jax.random.normal(jax.random.PRNGKey(0), (rows, cols), jnp.float32)
+    block = 512
+
+    def copy_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    def hbm_copy(v):
+        return pl.pallas_call(
+            copy_kernel,
+            grid=(rows // block,),
+            in_specs=[pl.BlockSpec((block, cols), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((block, cols), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((rows, cols), v.dtype),
+            interpret=jax.default_backend() != "tpu",
+        )(v)
+
+    t = _time_slope(hbm_copy, x, lo=1, hi=5, n=4, trials=3)
+    return 2 * x.size * 4 / t / 1e9  # read + write
+
+
+# ---------------------------------------------------------------------------
+# Workloads
+# ---------------------------------------------------------------------------
+
+# ResNet-50 fwd conv+fc flops at 224²: ~4.09 GFLOP/img (standard analytic
+# count); fwd+bwd ~ 3× (dgrad + wgrad each ≈ fwd)
+RN50_ANALYTIC_FLOPS_PER_IMG = 3 * 4.09e9
 
 
 def bench_resnet():
-    """Returns (images/sec, achieved TFLOPS, loss)."""
+    """Returns (images/sec, analytic TFLOPS, cost-analysis TFLOPS, loss)."""
     model = ResNet(resnet50_config())
     params, bn_state = model.init(jax.random.PRNGKey(0))
 
@@ -101,42 +187,70 @@ def bench_resnet():
                           jnp.bfloat16)
     y = jax.random.randint(jax.random.PRNGKey(2), (BATCH,), 0, 1000)
 
-    # warm the jit fastpath first (its dispatch is leaner than calling the
-    # AOT Compiled object), then read flops from an explicit lower+compile
-    # — the persistent XLA compile cache dedupes the second compilation
+    # warm the jit fastpath first, then read flops from an explicit
+    # lower+compile (the persistent compile cache dedupes it)
     params, bn_state, opt_state, scale_state, loss = train_step(
         params, bn_state, opt_state, scale_state, x, y)
     float(loss)
-    step_flops = profiling.cost_report_from_compiled(
+    cost_flops = profiling.cost_report_from_compiled(
         train_step.lower(params, bn_state, opt_state, scale_state,
                          x, y).compile()).flops
 
-    t0 = time.perf_counter()
-    for _ in range(STEPS):
-        params, bn_state, opt_state, scale_state, loss = train_step(
-            params, bn_state, opt_state, scale_state, x, y)
-    final_loss = float(loss)
-    dt = time.perf_counter() - t0
+    best_dt = float("inf")
+    trials = 1 if FAST else 2
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            params, bn_state, opt_state, scale_state, loss = train_step(
+                params, bn_state, opt_state, scale_state, x, y)
+        final_loss = float(loss)  # sync
+        best_dt = min(best_dt, (time.perf_counter() - t0) / STEPS)
     assert jnp.isfinite(final_loss), f"training diverged: {final_loss}"
-    ips = BATCH * STEPS / dt
-    tflops = step_flops * STEPS / dt / 1e12
-    return ips, tflops, final_loss
+    ips = BATCH / best_dt
+    analytic_tflops = ips * RN50_ANALYTIC_FLOPS_PER_IMG / 1e12
+    cost_tflops = cost_flops / best_dt / 1e12
+    return ips, analytic_tflops, cost_tflops, final_loss
+
+
+GPT_L, GPT_H, GPT_V, GPT_SEQ = 24, 1024, 51200, 1024
+
+
+def gpt_analytic_flops(n_tokens, batch, *, with_remat=False):
+    """Analytic fwd+bwd matmul flops for the 350M GPT (causal attention
+    counted at half density).  ``with_remat`` adds the transformer-body
+    forward recompute that remat="full" performs — the *hardware* flops,
+    vs the model flops used for MFU."""
+    body = 2 * 12 * GPT_H * GPT_H * GPT_L * n_tokens
+    attn = 2 * 2 * batch * GPT_SEQ * GPT_SEQ * GPT_H * GPT_L / 2
+    logits = 2 * n_tokens * GPT_H * GPT_V
+    fwd = body + attn + logits
+    total = 3 * fwd
+    if with_remat:
+        total += body + attn
+    return total
 
 
 def bench_gpt350m():
     """Megatron GPT-2 350M-class (hidden 1024, 24 layers, 16 heads, seq
-    1024) single-chip training throughput: (tokens/sec, achieved TFLOPS)."""
-    from jax.experimental.shard_map import shard_map
+    1024) single-chip training throughput.
+
+    Returns (tokens/sec, analytic model TFLOPS, analytic hw TFLOPS,
+    cost-analysis TFLOPS, remat_policy)."""
     from jax.sharding import PartitionSpec as P
 
     from apex_tpu.transformer import parallel_state
     from apex_tpu.transformer.testing import GPTConfig, GPTModel
 
-    B, SEQ = int(os.environ.get("BENCH_GPT_BATCH", "8")), 1024
-    cfg = GPTConfig(num_layers=24, hidden_size=1024, num_attention_heads=16,
-                    vocab_size=51200, max_position_embeddings=SEQ,
+    shard_map = jax.shard_map
+
+    B = int(os.environ.get("BENCH_GPT_BATCH", "8"))
+    remat_policy = os.environ.get("BENCH_GPT_REMAT", "full")
+    cfg = GPTConfig(num_layers=GPT_L, hidden_size=GPT_H,
+                    num_attention_heads=16, vocab_size=GPT_V,
+                    max_position_embeddings=GPT_SEQ,
                     tp_size=1, bf16=True,
-                    use_flash_attention=True, remat=True)
+                    use_flash_attention=True, remat=True,
+                    remat_policy=remat_policy)
     parallel_state.destroy_model_parallel()
     mesh = parallel_state.initialize_model_parallel(
         1, 1, devices=jax.devices()[:1])
@@ -145,52 +259,64 @@ def bench_gpt350m():
     params = model.shard_master(master, 0)
     opt = optimizers.FusedAdam(lr=1e-4)
     opt_state = opt.init(params)
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, SEQ), 0,
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, GPT_SEQ), 0,
                                 cfg.vocab_size)
     labels = jnp.roll(tokens, -1, axis=-1)
 
     @jax.jit
     def train_step(p, opt_state, t, l):
-        def run(p, t, l):
-            loss = jnp.mean(model.apply(p, t, labels=l))
-            return loss
-
         def lossf(p):
-            return shard_map(run, mesh=mesh, in_specs=(P(), P(), P()),
-                             out_specs=P(), check_rep=False)(p, t, l)
+            return shard_map(
+                lambda p, t, l: jnp.mean(model.apply(p, t, labels=l)),
+                mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(),
+                check_rep=False)(p, t, l)
 
         loss, grads = jax.value_and_grad(lossf)(p)
         p, opt_state = opt.step(grads, opt_state, p)
         return p, opt_state, loss
 
-    steps = 8
+    steps = 6
     params, opt_state, loss = train_step(params, opt_state, tokens, labels)
     float(loss)
-    step_flops = profiling.cost_report_from_compiled(
+    cost_flops = profiling.cost_report_from_compiled(
         train_step.lower(params, opt_state, tokens, labels).compile()).flops
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = train_step(params, opt_state, tokens,
-                                             labels)
-    final = float(loss)
-    dt = time.perf_counter() - t0
+    best_dt = float("inf")
+    for _ in range(1 if FAST else 3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = train_step(params, opt_state, tokens,
+                                                 labels)
+        final = float(loss)
+        best_dt = min(best_dt, (time.perf_counter() - t0) / steps)
     parallel_state.destroy_model_parallel()
     assert jnp.isfinite(final), f"gpt diverged: {final}"
-    return B * SEQ * steps / dt, step_flops * steps / dt / 1e12
+    n_tok = B * GPT_SEQ
+    model_fl = gpt_analytic_flops(n_tok, B)
+    hw_fl = gpt_analytic_flops(n_tok, B,
+                               with_remat=(remat_policy == "full"))
+    return (n_tok / best_dt, model_fl / best_dt / 1e12,
+            hw_fl / best_dt / 1e12, cost_flops / best_dt / 1e12,
+            remat_policy)
 
 
-def bench_attention_kernel():
-    """Pallas flash attention vs XLA naive (fwd, causal, bf16): speedup.
+# ---------------------------------------------------------------------------
+# Kernel microbenches — the "win or fall back" enforcement record
+# ---------------------------------------------------------------------------
 
-    s=4096 where the S×S materialization hurts naive structurally — the
-    relative number is stable across chip-state variance (absolute TFLOPS
-    over the relay are not)."""
+
+def bench_attention_kernel(bh, s, d, block_q, block_k):
+    """Pallas flash attention, fwd and fwd+bwd (causal, bf16): TFLOPS,
+    plus the XLA-naive fwd for reference."""
     from apex_tpu.ops.attention import flash_attention
 
-    bh, s, d = 16, 4096, 128
-    k = jax.random.normal(jax.random.PRNGKey(1), (bh, s, d), jnp.bfloat16)
-    v = jax.random.normal(jax.random.PRNGKey(2), (bh, s, d), jnp.bfloat16)
-    q = jax.random.normal(jax.random.PRNGKey(0), (bh, s, d), jnp.bfloat16)
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (bh, s, d), jnp.bfloat16) for kk in ks)
+    fwd_flops = 4 * bh * s * s * d / 2  # causal
+    bwd_flops = 2.5 * fwd_flops
+
+    def fwd(x):
+        return flash_attention(x, k, v, causal=True,
+                               block_q=block_q, block_k=block_k)
 
     def naive(x):
         s_ = jnp.einsum("bqd,bkd->bqk", x, k,
@@ -200,34 +326,133 @@ def bench_attention_kernel():
             jnp.bfloat16), v, preferred_element_type=jnp.float32).astype(
             jnp.bfloat16)
 
-    t_pallas = _bench_scan(lambda x: flash_attention(x, k, v, causal=True),
-                           q, 12)
-    t_naive = _bench_scan(naive, q, 12)
-    flops = 2 * 2 * bh * s * s * d / 2
-    return {
-        "pallas_tflops": round(flops / t_pallas / 1e12, 2),
-        "xla_naive_tflops": round(flops / t_naive / 1e12, 2),
-        "speedup": round(t_naive / t_pallas, 2),
+    def train(x):
+        def loss(q_, k_, v_):
+            return jnp.sum(fwd_loss_target(q_, k_, v_))
+        def fwd_loss_target(q_, k_, v_):
+            return flash_attention(q_, k_, v_, causal=True,
+                                   block_q=block_q,
+                                   block_k=block_k).astype(jnp.float32) * 1e-3
+        g = jax.grad(loss, argnums=(0, 1, 2))(x, k, v)
+        return x + g[0].astype(x.dtype) * 1e-6
+
+    t_f = _time_slope(fwd, q, lo=1, hi=4, n=5)
+    t_fb = _time_slope(train, q, lo=1, hi=3, n=4)
+    out = {
+        "fwd_tflops": round(fwd_flops / t_f / 1e12, 1),
+        "fwdbwd_tflops": round((fwd_flops + bwd_flops) / t_fb / 1e12, 1),
     }
+    try:
+        t_n = _time_slope(naive, q, lo=1, hi=3, n=4)
+        out["xla_naive_fwd_tflops"] = round(fwd_flops / t_n / 1e12, 1)
+        out["fwd_speedup_vs_naive"] = round(t_n / t_f, 2)
+    except Exception as e:  # long-seq naive can OOM — structural win
+        out["xla_naive_fwd_tflops"] = f"OOM/{repr(e)[:60]}"
+    return out
 
 
 def bench_layernorm_kernel():
-    """Pallas fused LN vs naive XLA LN (fwd, fp32): speedup (bandwidth-
-    bound — report GB/s)."""
-    from apex_tpu.ops.fused_layer_norm import _pallas_ln_fwd, _xla_ln_fwd
+    """Fused LN fwd and bwd, Pallas vs XLA, at a bandwidth-honest working
+    set (bf16 rows, 256 MB+ traffic per application): GB/s each.  The
+    winner keeps the TPU default — enforced in ops/fused_layer_norm.py."""
+    from apex_tpu.ops.fused_layer_norm import (
+        _pallas_ln_fwd, _xla_ln_fwd, layer_norm)
 
-    rows, cols = 8192, 1024
-    x = jax.random.normal(jax.random.PRNGKey(0), (rows, cols))
-    w = jnp.ones((cols,))
-    b = jnp.zeros((cols,))
+    rows, cols = 16384, 4096
+    x = jax.random.normal(jax.random.PRNGKey(0), (rows, cols), jnp.bfloat16)
+    w = jnp.ones((cols,), jnp.float32)
+    b = jnp.zeros((cols,), jnp.float32)
+    nbytes = rows * cols * 2
 
-    t_pallas = _bench_scan(lambda x: _pallas_ln_fwd(x, w, b, 1e-5)[0], x, 30)
-    t_xla = _bench_scan(lambda x: _xla_ln_fwd(x, w, b, 1e-5)[0], x, 30)
-    gbytes = 2 * rows * cols * 4 / 1e9  # read + write
+    t_p = _time_slope(lambda v: _pallas_ln_fwd(v, w, b, 1e-5)[0], x)
+    t_x = _time_slope(lambda v: _xla_ln_fwd(v, w, b, 1e-5)[0], x)
+    out = {
+        "fwd_pallas_gb_s": round(2 * nbytes / t_p / 1e9, 1),
+        "fwd_xla_gb_s": round(2 * nbytes / t_x / 1e9, 1),
+        "fwd_speedup": round(t_x / t_p, 2),
+    }
+
+    # backward: the fused dgrad+dgamma+dbeta custom_vjp vs jax AD of the
+    # naive formulation (what users get without the fused op)
+    def fused_bwd(v):
+        g = jax.grad(lambda xx: jnp.sum(
+            layer_norm(xx, w, b).astype(jnp.float32)))(v)
+        return g
+
+    def naive_ln(xx):
+        xf = xx.astype(jnp.float32)
+        mu = jnp.mean(xf, -1, keepdims=True)
+        var = jnp.mean((xf - mu) ** 2, -1, keepdims=True)
+        return (((xf - mu) * jax.lax.rsqrt(var + 1e-5)) * w + b).astype(
+            xx.dtype)
+
+    def ad_bwd(v):
+        return jax.grad(lambda xx: jnp.sum(
+            naive_ln(xx).astype(jnp.float32)))(v)
+
+    t_fb = _time_slope(fused_bwd, x, lo=1, hi=3, n=4)
+    t_ab = _time_slope(ad_bwd, x, lo=1, hi=3, n=4)
+    # fwd+bwd traffic ~ 4 passes over x (fwd read/write + bwd read x,g
+    # write dx)
+    out["bwd_fused_gb_s"] = round(4 * nbytes / t_fb / 1e9, 1)
+    out["bwd_ad_gb_s"] = round(4 * nbytes / t_ab / 1e9, 1)
+    out["bwd_speedup"] = round(t_ab / t_fb, 2)
+    return out
+
+
+def bench_softmax_kernel():
+    """Fused causal (upper-triang) scale-mask-softmax vs naive XLA."""
+    from apex_tpu.ops import AttnMaskType, FusedScaleMaskSoftmax
+
+    b, h, s = 8, 16, 1024
+    x = jax.random.normal(jax.random.PRNGKey(0), (b, h, s, s), jnp.bfloat16)
+    fused = FusedScaleMaskSoftmax(
+        input_in_fp16=False, input_in_bf16=True,
+        attn_mask_type=AttnMaskType.causal,
+        scaled_masked_softmax_fusion=True, softmax_in_fp32=True, scale=1.0)
+
+    def naive(v):
+        m = jnp.tril(jnp.ones((s, s), bool))
+        sc = jnp.where(m, v.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(sc, -1).astype(v.dtype)
+
+    t_f = _time_slope(lambda v: fused(v, None), x, lo=1, hi=3, n=4)
+    t_n = _time_slope(naive, x, lo=1, hi=3, n=4)
+    nbytes = x.size * 2
     return {
-        "pallas_gb_s": round(gbytes / t_pallas, 1),
-        "xla_gb_s": round(gbytes / t_xla, 1),
-        "speedup": round(t_xla / t_pallas, 2),
+        "fused_gb_s": round(2 * nbytes / t_f / 1e9, 1),
+        "xla_naive_gb_s": round(2 * nbytes / t_n / 1e9, 1),
+        "speedup": round(t_n / t_f, 2),
+    }
+
+
+def bench_xentropy_kernel():
+    """Fused vocab cross entropy (fwd+bwd) vs naive XLA formulation."""
+    n, v = 8192, 51200
+    logits = jax.random.normal(jax.random.PRNGKey(0), (n, v),
+                               jnp.float32) * 2
+    labels = jax.random.randint(jax.random.PRNGKey(1), (n,), 0, v)
+
+    def fused_step(x):
+        g = jax.grad(lambda lg: jnp.mean(
+            softmax_cross_entropy_loss(lg, labels)))(x)
+        return x - g
+
+    def naive_step(x):
+        def f(lg):
+            lse = jax.nn.logsumexp(lg, axis=-1)
+            nll = lse - jnp.take_along_axis(
+                lg, labels[:, None], axis=-1)[:, 0]
+            return jnp.mean(nll)
+        return x - jax.grad(f)(x)
+
+    t_f = _time_slope(fused_step, logits, lo=1, hi=3, n=3)
+    t_n = _time_slope(naive_step, logits, lo=1, hi=3, n=3)
+    nbytes = logits.size * 4
+    return {
+        "fused_gb_s": round(3 * nbytes / t_f / 1e9, 1),
+        "xla_naive_gb_s": round(3 * nbytes / t_n / 1e9, 1),
+        "speedup": round(t_n / t_f, 2),
     }
 
 
@@ -242,42 +467,62 @@ def main():
     note("matmul roof...")
     roof = bench_matmul_roof()
     extras["matmul_roof_tflops"] = round(roof, 1)
+    note("hbm roof...")
+    hbm = bench_hbm_roof()
+    extras["hbm_roof_gb_s"] = round(hbm, 1)
 
     note("resnet50...")
-    ips, rn_tflops, rn_loss = bench_resnet()
-    extras["resnet50_tflops"] = round(rn_tflops, 1)
+    ips, rn_tflops, rn_cost_tflops, rn_loss = bench_resnet()
+    extras["resnet50_analytic_tflops"] = round(rn_tflops, 1)
+    extras["resnet50_cost_analysis_tflops"] = round(rn_cost_tflops, 1)
     extras["resnet50_final_loss"] = round(rn_loss, 3)
+    extras["resnet50_mfu_vs_roof"] = round(rn_tflops / roof, 3)
 
-    gpt_tflops = 0.0
     if not FAST:
         note("gpt350m...")
         try:
-            tok_s, gpt_tflops = bench_gpt350m()
+            tok_s, model_tf, hw_tf, cost_tf, policy = bench_gpt350m()
             extras["gpt350m_tokens_per_sec"] = round(tok_s, 0)
-            extras["gpt350m_tflops"] = round(gpt_tflops, 1)
+            extras["gpt350m_model_tflops"] = round(model_tf, 1)
+            extras["gpt350m_hw_tflops"] = round(hw_tf, 1)
+            extras["gpt350m_cost_analysis_tflops"] = round(cost_tf, 1)
+            extras["gpt350m_remat_policy"] = policy
+            extras["gpt350m_mfu_vs_roof"] = round(model_tf / roof, 3)
         except Exception as e:  # keep the headline alive
             extras["gpt350m_error"] = repr(e)[:200]
 
-    # the roof is measured on the same (possibly contended) machine; any
-    # workload observed above it raises the roof so every MFU stays
-    # honest <= 1
-    roof = max(roof, rn_tflops, gpt_tflops)
-    extras["matmul_roof_tflops"] = round(roof, 1)
-    extras["resnet50_mfu_vs_roof"] = round(rn_tflops / roof, 3)
-    if gpt_tflops:
-        extras["gpt350m_mfu_vs_roof"] = round(gpt_tflops / roof, 3)
-
-    if not FAST:
-        note("flash attention microbench...")
+        note("flash attention microbench (GPT shape)...")
         try:
-            extras["flash_attention"] = bench_attention_kernel()
+            r = bench_attention_kernel(128, 1024, 64, 512, 512)
+            r["fwd_frac_of_roof"] = round(r["fwd_tflops"] / roof, 3)
+            extras["flash_attention_s1024"] = r
         except Exception as e:
-            extras["flash_attention_error"] = repr(e)[:200]
+            extras["flash_attention_s1024_error"] = repr(e)[:200]
+        note("flash attention microbench (long seq)...")
+        try:
+            r = bench_attention_kernel(16, 4096, 128, 1024, 1024)
+            r["fwd_frac_of_roof"] = round(r["fwd_tflops"] / roof, 3)
+            extras["flash_attention_s4096"] = r
+        except Exception as e:
+            extras["flash_attention_s4096_error"] = repr(e)[:200]
         note("layer norm microbench...")
         try:
-            extras["layer_norm"] = bench_layernorm_kernel()
+            r = bench_layernorm_kernel()
+            r["fwd_frac_of_hbm"] = round(
+                r["fwd_pallas_gb_s"] / max(hbm, 1e-9), 3)
+            extras["layer_norm"] = r
         except Exception as e:
             extras["layer_norm_error"] = repr(e)[:200]
+        note("softmax microbench...")
+        try:
+            extras["fused_softmax"] = bench_softmax_kernel()
+        except Exception as e:
+            extras["fused_softmax_error"] = repr(e)[:200]
+        note("xentropy microbench...")
+        try:
+            extras["xentropy"] = bench_xentropy_kernel()
+        except Exception as e:
+            extras["xentropy_error"] = repr(e)[:200]
 
     baseline = None
     try:

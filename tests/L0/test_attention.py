@@ -363,3 +363,27 @@ class TestMultiheadAttnModules:
                      dropout_rng=jax.random.PRNGKey(10))
         o2 = m.apply(p, x, is_training=False)
         assert not np.allclose(o1, o2)
+
+
+def test_trainable_mask_bias_gets_gradient():
+    """mask_is_constant=False must produce a real (nonzero) bias gradient
+    (ADVICE r2: the default path silently returns zeros for it)."""
+    from apex_tpu.ops.attention import flash_attention
+
+    k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(0), 4)
+    q = jax.random.normal(k1, (2, 16, 8))
+    k = jax.random.normal(k2, (2, 16, 8))
+    v = jax.random.normal(k3, (2, 16, 8))
+    bias = jax.random.normal(k4, (1, 16, 16)) * 0.1
+
+    def loss(b):
+        return jnp.sum(flash_attention(q, k, v, mask_bias=b,
+                                       mask_is_constant=False) ** 2)
+
+    g = jax.grad(loss)(bias)
+    assert jnp.abs(g).max() > 0
+    # and the default (constant-mask) path still returns zeros, documented
+    def loss_const(b):
+        return jnp.sum(flash_attention(q, k, v, mask_bias=b) ** 2)
+    g0 = jax.grad(loss_const)(bias)
+    assert jnp.abs(g0).max() == 0

@@ -281,3 +281,28 @@ def test_packed_raw_half_bits(tmp_path):
     restored, _ = ckpt.restore_checkpoint(str(tmp_path), target=params)
     np.testing.assert_array_equal(np.asarray(restored["h"]),
                                   np.asarray(params["h"]))
+
+
+def test_restore_without_target_handles_odd_keys(tmp_path):
+    """Dict keys containing quotes/brackets/dots survive target=None
+    restore via the manifest's structured path components (ADVICE r2:
+    keystr re-parsing mangled them)."""
+    tree = {"a'b": {"c[0].d": jnp.ones((2,))}, "plain": jnp.zeros((1,))}
+    ckpt.save_checkpoint(str(tmp_path), tree, step=0)
+    out, _ = ckpt.restore_checkpoint(str(tmp_path))
+    np.testing.assert_array_equal(np.asarray(out["a'b"]["c[0].d"]),
+                                  np.ones((2,)))
+    np.testing.assert_array_equal(np.asarray(out["plain"]), np.zeros((1,)))
+
+
+def test_colliding_keystrs_round_trip(tmp_path):
+    """Two distinct leaves whose mangled keystrs collide must both
+    survive save + restore (with and without target)."""
+    tree = {"x": {"y": jnp.ones((2,)) * 3}, "x']['y": jnp.ones((2,)) * 7}
+    ckpt.save_checkpoint(str(tmp_path), tree, step=0)
+    back, _ = ckpt.restore_checkpoint(str(tmp_path), target=tree)
+    np.testing.assert_array_equal(np.asarray(back["x"]["y"]), 3 * np.ones(2))
+    np.testing.assert_array_equal(np.asarray(back["x']['y"]), 7 * np.ones(2))
+    out, _ = ckpt.restore_checkpoint(str(tmp_path))
+    np.testing.assert_array_equal(np.asarray(out["x"]["y"]), 3 * np.ones(2))
+    np.testing.assert_array_equal(np.asarray(out["x']['y"]), 7 * np.ones(2))

@@ -345,3 +345,103 @@ class TestFlashAndRemat:
                         jax.tree_util.tree_leaves(g1)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-5)
+
+
+class TestDropout:
+    """The reference RNG-tracker property (run_random_test.py +
+    random.py:193-221): dropout on TP-*replicated* activations must be
+    identical across ranks, dropout on TP-*sharded* activations must
+    differ — and the model must stay TP-consistent with both on."""
+
+    def test_mask_streams_tp_property(self):
+        from apex_tpu.transformer.tensor_parallel.random import (
+            dropout, model_parallel_dropout_key)
+
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel(4, 1)
+        base = jax.random.PRNGKey(3)
+        x = jnp.ones((64, 16))
+
+        def run(_):
+            rep = dropout(x, 0.5, base)                           # replicated
+            shd = dropout(x, 0.5, model_parallel_dropout_key(base))  # sharded
+            return rep[None], shd[None]
+
+        rep, shd = shard_map(
+            run, mesh=mesh, in_specs=(P("tensor"),),
+            out_specs=(P("tensor"), P("tensor")), check_rep=False)(
+            jnp.zeros((4, 1)))
+        parallel_state.destroy_model_parallel()
+        for r in range(1, 4):
+            np.testing.assert_array_equal(np.asarray(rep[0]),
+                                          np.asarray(rep[r]))
+        assert any(not np.array_equal(np.asarray(shd[0]), np.asarray(shd[r]))
+                   for r in range(1, 4))
+
+    def _dropout_cfg(self, tp):
+        return GPTConfig(num_layers=2, hidden_size=32, num_attention_heads=4,
+                         vocab_size=VOCAB, max_position_embeddings=SEQ,
+                         tp_size=tp, attention_dropout=0.3,
+                         hidden_dropout=0.25)
+
+    def test_dropout_active_and_deterministic(self):
+        cfg = self._dropout_cfg(1)
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel(1, 1)
+        model = GPTModel(cfg)
+        params = model.shard_master(model.init_master(jax.random.PRNGKey(0)), 0)
+        tokens, labels = _tokens(jax.random.PRNGKey(1)), _tokens(jax.random.PRNGKey(2))
+
+        def loss(key):
+            def run(p, t, l):
+                return jnp.mean(model.apply(p, t, labels=l, dropout_key=key))
+            return float(shard_map(run, mesh=mesh, in_specs=(P(), P(), P()),
+                                   out_specs=P(), check_rep=False)(
+                params, tokens, labels))
+
+        def loss_eval():
+            def run(p, t, l):
+                return jnp.mean(model.apply(p, t, labels=l))
+            return float(shard_map(run, mesh=mesh, in_specs=(P(), P(), P()),
+                                   out_specs=P(), check_rep=False)(
+                params, tokens, labels))
+
+        la = loss(jax.random.PRNGKey(7))
+        lb = loss(jax.random.PRNGKey(7))
+        lc = loss(jax.random.PRNGKey(8))
+        le = loss_eval()
+        parallel_state.destroy_model_parallel()
+        assert la == lb                  # same key -> bitwise same
+        assert la != lc                  # different key -> different masks
+        assert la != le                  # dropout actually does something
+        assert np.isfinite(la) and np.isfinite(le)
+
+    def test_tp2_stays_consistent_with_dropout(self):
+        """With attention (sharded-stream) AND hidden (replicated-stream)
+        dropout on, every TP rank must compute the SAME transformer
+        output — the property the whole tracker design exists for.  It
+        fails if hidden dropout ever uses a per-rank stream."""
+        cfg = self._dropout_cfg(2)
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel(2, 1)
+        model = GPTModel(cfg)
+        master = GPTModel(self._dropout_cfg(1)).init_master(
+            jax.random.PRNGKey(0))
+        shards = [model.shard_master(master, r) for r in range(2)]
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *shards)
+        tokens = _tokens(jax.random.PRNGKey(1))
+        key = jax.random.PRNGKey(11)
+
+        def run(p, t):
+            p = jax.tree_util.tree_map(lambda a: a[0], p)
+            h = model.embed(p, t)
+            h = model.transformer.apply(p["transformer"], h,
+                                        dropout_key=key)
+            return h[None]
+
+        hs = shard_map(run, mesh=mesh, in_specs=(P("tensor"), P()),
+                       out_specs=P("tensor"), check_rep=False)(
+            stacked, tokens)
+        parallel_state.destroy_model_parallel()
+        np.testing.assert_allclose(np.asarray(hs[0]), np.asarray(hs[1]),
+                                   rtol=1e-5, atol=1e-6)

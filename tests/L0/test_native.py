@@ -124,3 +124,25 @@ class TestNativeRecordLoader:
         write_records(p, np.zeros((2, self.RB), np.uint8))
         with pytest.raises(RuntimeError):
             NativeRecordLoader([p], self.RB, 8)
+
+    def test_truncated_file_surfaces_error_count(self, tmp_path):
+        """IO failures must not be silent: a file whose tail is truncated
+        mid-record yields zero-filled records AND a nonzero error_count
+        (ADVICE r2: silent zero-fill was training-data corruption)."""
+        n = 16
+        recs = np.full((n, self.RB), 7, np.uint8)
+        p = str(tmp_path / "t.bin")
+        write_records(p, recs)
+        with NativeRecordLoader([p], self.RB, 4, shuffle=False,
+                                num_threads=1, queue_depth=1) as ld:
+            assert ld.error_count == 0
+            ld.next_batch()
+            # truncate the file mid-way: later records now fail to read
+            with open(p, "r+b") as f:
+                f.truncate(self.RB * 6 + 3)
+            bad = 0
+            for _ in range(ld.batches_per_epoch - 1):
+                b = ld.next_batch()
+                bad += int((b == 0).all(axis=1).sum())
+            assert ld.error_count > 0
+            assert ld.error_count >= bad > 0
