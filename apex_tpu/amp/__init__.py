@@ -25,6 +25,7 @@ scaling stays available for fp16 parity and for gradient-range hygiene).
 """
 
 from apex_tpu.amp import handle  # noqa: F401
+from apex_tpu.amp.opt import OptimWrapper  # noqa: F401
 from apex_tpu.amp.handle import (  # noqa: F401
     scale_loss,
     scaled_value_and_grad,

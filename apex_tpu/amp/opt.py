@@ -1,0 +1,76 @@
+"""Legacy ``amp.opt`` surface: OptimWrapper.
+
+The reference's ``apex/amp/opt.py:9-104`` wraps an eager optimizer so
+each of ``num_loss`` losses gets its own loss scaler, selected by a
+``scale_loss`` context manager that mutates global handle state —
+deprecated even in-reference (superseded by ``amp.initialize``'s
+``num_losses``).  The functional mapping bundles an
+:class:`~apex_tpu.optimizers.base.Optimizer` with N independent
+:class:`~apex_tpu.amp.scaler.LossScaleState` values; "which scaler this
+backward uses" is an explicit ``loss_id`` instead of ambient state.
+
+Kept for porting convenience; new code should hold scaler states
+directly (see examples/dcgan/main_amp.py for the multi-loss pattern).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+
+from apex_tpu.amp.handle import scaled_value_and_grad, skip_or_step
+from apex_tpu.amp.scaler import LossScaler
+
+__all__ = ["OptimWrapper"]
+
+
+class OptimWrapper:
+    """Optimizer + ``num_loss`` independent dynamic loss scalers.
+
+    State is the tuple ``(opt_state, (scale_state_0, ...))`` returned by
+    :meth:`init`; every method is pure and jit-safe.
+    """
+
+    def __init__(self, optimizer, scaler: LossScaler = None,
+                 num_loss: int = 1):
+        self.optimizer = optimizer
+        self.scaler = scaler or LossScaler()
+        self.num_loss = int(num_loss)
+
+    def init(self, params) -> Tuple[Any, Tuple]:
+        return (self.optimizer.init(params),
+                tuple(self.scaler.init() for _ in range(self.num_loss)))
+
+    def scaled_grad(self, loss_fn: Callable, state, *args,
+                    loss_id: int = 0, has_aux: bool = False):
+        """Backward under loss ``loss_id``'s scale (the reference's
+        ``with wrapper.scale_loss(loss) as scaled:`` flow).  Returns
+        ``((loss[, aux]), grads, finite)`` with unscaled fp32 grads."""
+        _, scale_states = state
+        fn = scaled_value_and_grad(loss_fn, self.scaler, has_aux=has_aux)
+        return fn(scale_states[loss_id], *args)
+
+    def step(self, state, params, grads, finite, *, loss_id: int = 0):
+        """Apply the update if ``finite``; always advance loss ``loss_id``'s
+        scale state (grow/shrink law).  Returns ``(params, state)``."""
+        opt_state, scale_states = state
+        new_p, new_opt = self.optimizer.step(grads, opt_state, params)
+        params, opt_state = skip_or_step(
+            finite, (new_p, new_opt), (params, opt_state))
+        scale_states = tuple(
+            self.scaler.update(s, finite) if i == loss_id else s
+            for i, s in enumerate(scale_states))
+        return params, (opt_state, scale_states)
+
+    # reference state_dict parity (opt.py:93-97)
+    def state_dict(self, state):
+        opt_state, scale_states = state
+        return {
+            "opt_state": opt_state,
+            "scalers": [
+                {"loss_scale": float(jax.device_get(s.loss_scale)),
+                 "unskipped": int(jax.device_get(s.unskipped))}
+                for s in scale_states
+            ],
+        }

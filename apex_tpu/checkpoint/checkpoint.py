@@ -85,8 +85,10 @@ def _is_spec_leaf(x) -> bool:
 
 def _spec_map(shardings, tree) -> dict:
     """Flatten a ``shardings`` pytree that may be a *structure prefix* of
-    ``tree`` into ``{leaf keystr: PartitionSpec}`` (a prefix spec applies to
-    every leaf under its subtree — same broadcast rule as pjit in_shardings)."""
+    ``tree`` into ``{structured-path-tuple: PartitionSpec}`` (a prefix
+    spec applies to every leaf under its subtree — same broadcast rule
+    as pjit in_shardings).  Keyed by structured path, not keystr, so
+    spec association survives keystr mangling/collisions."""
     flat_specs: list = []
 
     def _collect(spec, subtree):
@@ -100,7 +102,7 @@ def _spec_map(shardings, tree) -> dict:
     if len(paths) != len(flat_specs):
         raise ValueError("shardings tree is not a structure prefix of the checkpoint tree")
     return {
-        _keystr(path): spec
+        tuple(_path_parts(path)): spec
         for (path, _), spec in zip(paths, flat_specs)
         if spec is not None
     }
@@ -221,8 +223,9 @@ def save_checkpoint(
                 # npz can't round-trip ml_dtypes natively: store the raw bits
                 val = val.view(np.uint16)
                 entry["stored_dtype"] = "uint16_bits"
-        if key in spec_map:
-            entry["spec"] = _spec_to_json(spec_map[key])
+        ptuple = tuple(entry["path"])
+        if ptuple in spec_map:
+            entry["spec"] = _spec_to_json(spec_map[ptuple])
         manifest["leaves"][key] = entry
         arrays[key] = val
 
@@ -323,7 +326,8 @@ def restore_checkpoint(
         # no target to broadcast a prefix against: shardings must be
         # leaf-exact here
         spec_map = {
-            _keystr(path): (s.spec if isinstance(s, NamedSharding) else s)
+            tuple(_path_parts(path)): (s.spec if isinstance(s, NamedSharding)
+                                       else s)
             for path, s in jax.tree_util.tree_flatten_with_path(
                 shardings, is_leaf=_is_spec_leaf
             )[0]
@@ -339,7 +343,9 @@ def restore_checkpoint(
         dtype = want_dtype if want_dtype is not None else jnp.dtype(entry["dtype"])
         arr = jnp.asarray(val).astype(dtype)
         if mesh is not None:
-            spec = spec_map.get(key)
+            ptuple = (tuple(entry["path"]) if "path" in entry
+                      else tuple(_parse_keystr(key)))
+            spec = spec_map.get(ptuple)
             if spec is None and entry.get("spec") is not None:
                 spec = _spec_from_json(entry["spec"])
             if spec is None:

@@ -52,6 +52,10 @@ class OpTime:
 _SKIP_NAMES = re.compile(
     r"^(\$|process_|thread_|MemcpyD2H|MemcpyH2D|Memset|"
     r"RunGraph|Stream|Compile|Execute|TransferTo|xla::|pjrt)", re.I)
+# whole-module execution spans, e.g. "jit_step(123...)": they duplicate
+# every op inside them but sit on their own lane, so containment
+# filtering can't drop them — drop by name shape
+_MODULE_SPAN = re.compile(r"^jit_.*\(\d+\)$")
 
 
 def _device_pid_names(trace: dict) -> Dict[int, str]:
@@ -63,12 +67,42 @@ def _device_pid_names(trace: dict) -> Dict[int, str]:
     return names
 
 
+def _leaf_events(events):
+    """Keep only LEAF complete-events per (pid, tid) lane: an event that
+    contains another event's interval is a container (a trace group, the
+    jit module span, a step lane) and would double-count its children."""
+    by_lane: Dict[Any, list] = collections.defaultdict(list)
+    for ev in events:
+        by_lane[(ev.get("pid"), ev.get("tid"))].append(ev)
+    leaves = []
+    for lane in by_lane.values():
+        lane.sort(key=lambda e: (float(e.get("ts", 0.0)),
+                                 -float(e.get("dur", 0.0))))
+        open_evs = []  # (end_ts, event, became_parent)
+        for ev in lane:
+            ts = float(ev.get("ts", 0.0))
+            end = ts + float(ev.get("dur", 0.0))
+            while open_evs and open_evs[-1][0] <= ts:
+                e, parent = open_evs.pop()[1:]
+                if not parent:
+                    leaves.append(e)
+            if open_evs:
+                open_evs[-1] = (open_evs[-1][0], open_evs[-1][1], True)
+            open_evs.append((end, ev, False))
+        for _, e, parent in open_evs:
+            if not parent:
+                leaves.append(e)
+    return leaves
+
+
 def parse_trace_dir(logdir: str, *, device_only: bool = True
                     ) -> List[OpTime]:
     """Aggregate complete ('X') events from every ``*.trace.json.gz``
     under ``logdir`` into per-name totals, device timeline only (pids
     whose process name mentions a device) unless ``device_only=False``
-    or no device pids exist (then: every non-metadata timeline)."""
+    or no device pids exist (then: every non-metadata timeline).  Only
+    *leaf* events count — containers (step lanes, module spans) hold
+    their children's time and would double-count."""
     paths = glob.glob(os.path.join(logdir, "**", "*.trace.json.gz"),
                       recursive=True)
     paths += glob.glob(os.path.join(logdir, "**", "*.trace.json"),
@@ -87,14 +121,20 @@ def parse_trace_dir(logdir: str, *, device_only: bool = True
                        if re.search(r"TPU|GPU|Device|/device:|Chip|axon",
                                     n, re.I)}
         use_filter = device_only and bool(device_pids)
+        pool = []
         for ev in trace.get("traceEvents", []):
             if ev.get("ph") != "X":
                 continue
             if use_filter and ev.get("pid") not in device_pids:
                 continue
             name = ev.get("name", "")
-            if not name or _SKIP_NAMES.match(name):
+            if (not name or _SKIP_NAMES.match(name)
+                    or _MODULE_SPAN.match(name)
+                    or name.isdigit()):  # bare step-number lanes
                 continue
+            pool.append(ev)
+        for ev in _leaf_events(pool):
+            name = ev["name"]
             totals[name] += float(ev.get("dur", 0.0)) / 1e3  # us -> ms
             counts[name] += 1
     grand = sum(totals.values()) or 1.0
@@ -114,26 +154,27 @@ def top_ops_report(fn: Callable, *args, steps: int = 3,
     warmed (compile inside the trace would dominate)."""
     owndir = logdir is None
     logdir = logdir or tempfile.mkdtemp(prefix="apex_tpu_prof_")
-    jax.profiler.start_trace(logdir)
     try:
-        out = None
-        for _ in range(steps):
-            out = fn(*args, **kwargs)
-        jax.block_until_ready(out)
-        # the relay's block_until_ready can return early; a value fetch
-        # cannot (same discipline as bench.py)
-        for leaf in jax.tree_util.tree_leaves(out):
-            if hasattr(leaf, "astype"):
-                float(abs(leaf).max())
-                break
+        jax.profiler.start_trace(logdir)
+        try:
+            out = None
+            for _ in range(steps):
+                out = fn(*args, **kwargs)
+            jax.block_until_ready(out)
+            # the relay's block_until_ready can return early; a value
+            # fetch cannot (same discipline as bench.py)
+            for leaf in jax.tree_util.tree_leaves(out):
+                if hasattr(leaf, "astype"):
+                    float(abs(leaf).max())
+                    break
+        finally:
+            jax.profiler.stop_trace()
+        return parse_trace_dir(logdir)[:top]
     finally:
-        jax.profiler.stop_trace()
-    ops = parse_trace_dir(logdir)[:top]
-    if owndir:
-        import shutil
+        if owndir:
+            import shutil
 
-        shutil.rmtree(logdir, ignore_errors=True)
-    return ops
+            shutil.rmtree(logdir, ignore_errors=True)
 
 
 def format_top_ops(ops: Sequence[OpTime], *, top: int = 10) -> str:

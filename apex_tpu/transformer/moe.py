@@ -1,0 +1,163 @@
+"""Expert parallelism: a Switch-style Mixture-of-Experts MLP.
+
+The 2021 reference predates MoE (no analog in apex; Megatron grew
+SwitchMLP later), but expert parallelism is a first-class axis of the
+modern parallelism surface (tp/pp/dp/sp/**ep**) and shapes the same
+collective design the rest of :mod:`apex_tpu.transformer` builds on —
+so it lives here as a TPU-first extension rather than a parity item.
+
+Design (token-choice top-1, Switch Transformer):
+
+- gate: ``logits = h @ wg`` → per-token expert id + gate weight;
+- **static-shape dispatch**: each expert has a fixed capacity
+  ``C = ceil(T · capacity_factor / E)``; tokens scatter into an
+  ``[E, C, H]`` buffer by (expert, position-within-expert) with
+  overflow dropped (they ride the residual), the standard
+  compile-friendly formulation — no dynamic shapes anywhere;
+- **all_to_all over the "expert" mesh axis** re-buckets the dispatch
+  buffer so each rank holds ``E/world`` whole experts applied to every
+  rank's tokens (one ICI all_to_all each way, the MoE communication
+  pattern);
+- per-expert FFN as one batched einsum over the local experts (MXU
+  sees ``[E_local, world·C, H] × [E_local, H, F]``);
+- combine: the returning buffer is gathered back per token and scaled
+  by the gate weight.
+
+Everything runs inside ``shard_map``; with ``axis_name=None`` the same
+code is a single-device MoE (world=1), which is what the unit tests
+exercise against a dense per-token reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["MoEConfig", "SwitchMLP"]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    hidden_size: int
+    ffn_hidden_size: int
+    num_experts: int
+    capacity_factor: float = 1.25
+    # auxiliary load-balancing loss coefficient (Switch eq. 4)
+    aux_loss_coeff: float = 1e-2
+    init_method_std: float = 0.02
+
+
+class SwitchMLP:
+    """Top-1 routed MLP.  ``num_experts`` must divide by the expert-axis
+    world size; each rank owns ``num_experts / world`` experts."""
+
+    def __init__(self, cfg: MoEConfig):
+        self.cfg = cfg
+
+    def init_master(self, key):
+        cfg = self.cfg
+        kg, k1, k2 = jax.random.split(key, 3)
+        std = cfg.init_method_std
+        return {
+            "gate": {"weight": jax.random.normal(
+                kg, (cfg.hidden_size, cfg.num_experts)) * std},
+            "experts": {
+                "w1": jax.random.normal(
+                    k1, (cfg.num_experts, cfg.hidden_size,
+                         cfg.ffn_hidden_size)) * std,
+                "b1": jnp.zeros((cfg.num_experts, cfg.ffn_hidden_size)),
+                "w2": jax.random.normal(
+                    k2, (cfg.num_experts, cfg.ffn_hidden_size,
+                         cfg.hidden_size)) * std,
+                "b2": jnp.zeros((cfg.num_experts, cfg.hidden_size)),
+            },
+        }
+
+    def shard_master(self, master, rank, world: int):
+        """Slice this rank's experts (gate is replicated)."""
+        e_local = self.cfg.num_experts // world
+        sl = slice(rank * e_local, (rank + 1) * e_local)
+        return {
+            "gate": master["gate"],
+            "experts": jax.tree_util.tree_map(
+                lambda a: a[sl], master["experts"]),
+        }
+
+    def capacity(self, n_tokens: int) -> int:
+        """Per-expert slot count for ``n_tokens`` LOCAL tokens (capacity
+        is per dispatching rank; world size does not enter)."""
+        return max(1, math.ceil(
+            n_tokens * self.cfg.capacity_factor / self.cfg.num_experts))
+
+    def apply(self, params, h, *, axis_name: Optional[str] = None):
+        """h: [T, H] (this rank's tokens).  Returns ``(out, aux_loss)``.
+
+        Inside ``shard_map`` with ``axis_name`` bound, experts are
+        sharded over that axis and two ``all_to_all`` collectives move
+        tokens to their experts and back.  ``aux_loss`` is the Switch
+        load-balancing loss (already mean-normalized; add
+        ``cfg.aux_loss_coeff * aux_loss`` to the model loss).
+        """
+        cfg = self.cfg
+        T, H = h.shape
+        E = cfg.num_experts
+        world = 1 if axis_name is None else jax.lax.psum(1, axis_name)
+        e_local = E // world
+        C = self.capacity(T)
+
+        logits = h.astype(jnp.float32) @ params["gate"]["weight"].astype(
+            jnp.float32)                                   # [T, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)                # [T]
+        gate_w = jnp.max(probs, axis=-1)                   # [T]
+
+        # position of each token in its expert's queue; overflow drops
+        onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)      # [T, E]
+        pos = (jnp.cumsum(onehot, axis=0) - 1)                   # [T, E]
+        pos = jnp.sum(pos * onehot, axis=-1)                     # [T]
+        keep = pos < C
+
+        # Switch aux loss: E * sum_e(frac_tokens_e * mean_prob_e)
+        frac = jnp.mean(onehot.astype(jnp.float32), axis=0)
+        mean_p = jnp.mean(probs, axis=0)
+        aux_loss = E * jnp.sum(frac * mean_p)
+
+        disp = jnp.zeros((E, C, H), h.dtype)
+        disp = disp.at[expert, jnp.where(keep, pos, 0)].add(
+            jnp.where(keep[:, None], h, 0), mode="drop")
+
+        if axis_name is not None and world > 1:
+            # [E, C, H] -> peers; receive [world, e_local, C, H]:
+            # every rank's tokens for MY experts
+            disp = jax.lax.all_to_all(
+                disp.reshape(world, e_local, C, H), axis_name,
+                split_axis=0, concat_axis=0, tiled=True)
+        x = disp.reshape(world, e_local, C, H)
+        x = jnp.moveaxis(x, 0, 1).reshape(e_local, world * C, H)
+
+        ex = params["experts"]
+        inter = jnp.einsum("ech,ehf->ecf", x.astype(jnp.float32),
+                           ex["w1"].astype(jnp.float32)) + ex["b1"][:, None]
+        inter = jax.nn.gelu(inter, approximate=True)
+        out = jnp.einsum("ecf,efh->ech", inter,
+                         ex["w2"].astype(jnp.float32)) + ex["b2"][:, None]
+        out = out.astype(h.dtype)
+
+        out = jnp.moveaxis(out.reshape(e_local, world, C, H), 1, 0)
+        out = out.reshape(world * e_local, C, H)
+        if axis_name is not None and world > 1:
+            out = jax.lax.all_to_all(
+                out.reshape(world, e_local, C, H), axis_name,
+                split_axis=0, concat_axis=0, tiled=True).reshape(E, C, H)
+        else:
+            out = out.reshape(E, C, H)
+
+        # combine: gather each token's expert output, gate-scale; dropped
+        # tokens contribute zero (caller's residual carries them)
+        tok_out = out[expert, jnp.where(keep, pos, 0)]
+        tok_out = jnp.where(keep[:, None], tok_out, 0)
+        return (tok_out * gate_w[:, None].astype(h.dtype)), aux_loss

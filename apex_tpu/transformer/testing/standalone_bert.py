@@ -29,6 +29,7 @@ from apex_tpu.transformer.testing.standalone_gpt import (
     GPTConfig,
     ParallelTransformer,
     _normal_init,
+    embedding_dropout,
 )
 
 
@@ -127,14 +128,20 @@ class BertModel:
         return logits + lm["bias"]
 
     def apply(self, params, tokens, attention_mask=None, tokentype_ids=None,
-              lm_labels=None):
-        """Returns ``(lm_losses_or_logits, binary_logits)``."""
+              lm_labels=None, dropout_key=None):
+        """Returns ``(lm_losses_or_logits, binary_logits)``.
+
+        ``dropout_key`` enables the config's attention/hidden dropout
+        (training mode), with the same TP-replicated/per-rank stream
+        discipline as the GPT (see standalone_gpt.GPTModel.apply)."""
         h = self.embed(params, tokens, tokentype_ids)
+        h = embedding_dropout(h, self.cfg, dropout_key)
         # padding mask [b, 1, 1, s] -> broadcast [b, 1, s, s], True = masked
         am = None
         if attention_mask is not None:
             am = ~attention_mask[:, None, None, :].astype(bool)
-        h = self.transformer.apply(params["transformer"], h, am)
+        h = self.transformer.apply(params["transformer"], h, am,
+                                   dropout_key=dropout_key)
 
         binary_logits = None
         if self.cfg.add_binary_head and "binary_head" in params:

@@ -212,6 +212,17 @@ class ParallelMLP:
         return self.dense_4h_to_h.apply(params["dense_4h_to_h"], inter)
 
 
+def embedding_dropout(h, cfg, dropout_key):
+    """Dropout on the embedding output (reference Embedding.forward
+    applies hidden_dropout before the first layer).  Replicated stream;
+    one shared derivation so GPT and BERT keep identical RNG
+    conventions."""
+    if dropout_key is None or cfg.hidden_dropout <= 0.0:
+        return h
+    return _dropout(h, cfg.hidden_dropout,
+                    jax.random.fold_in(dropout_key, 0x0E0B))
+
+
 def _hidden_dropout(x, cfg, key):
     """Post-RowParallel hidden dropout: the activation is TP-replicated, so
     the *base* (replicated) key is correct — every rank must drop the same
@@ -386,11 +397,7 @@ class GPTModel:
         TP-replicated — per-rank streams are derived inside (reference RNG
         tracker discipline, random.py:193-221)."""
         h = self.embed(params, tokens)
-        if dropout_key is not None and self.cfg.hidden_dropout > 0.0:
-            # embedding dropout (reference Embedding.forward applies
-            # hidden_dropout before the first layer)
-            h = _dropout(h, self.cfg.hidden_dropout,
-                         jax.random.fold_in(dropout_key, 0x0E0B))
+        h = embedding_dropout(h, self.cfg, dropout_key)
         h = self.transformer.apply(params["transformer"], h, attention_mask,
                                    dropout_key=dropout_key)
         logits_local = self.head_logits_local(params, h)

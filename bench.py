@@ -30,6 +30,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extras"}.
 ``vs_baseline`` compares against BASELINE.json["measured"].
 """
 
+import functools
 import json
 import os
 import time
@@ -53,7 +54,7 @@ def _fetch(x):
     return float(jnp.sum(x.astype(jnp.float32)))
 
 
-def _time_slope(op, x, *, lo=1, hi=5, n=6, trials=5):
+def _time_slope(op, x, *aux, lo=1, hi=5, n=6, trials=5):
     """Seconds per application of ``op`` with fixed dispatch/iteration
     overheads cancelled AND contention rejected: time(scan of n iters
     doing K ops each) is sampled ``trials`` times interleaved for K=lo
@@ -63,42 +64,70 @@ def _time_slope(op, x, *, lo=1, hi=5, n=6, trials=5):
     per-pair slope can even go negative when the chip speed shifts
     between the two samples.
 
-    ``op`` must map a value to a like-shaped value (data-dependent
-    chaining keeps applications sequential on device)."""
+    ``op(c, *aux)`` must map ``c`` to a like-shaped value
+    (data-dependent chaining keeps applications sequential on device).
+    Large constant operands MUST be passed via ``aux``, not closed
+    over: closure-captured arrays bake into the HLO as constants, and
+    a 100 MB program body hangs/truncates the relay's compile service."""
+    return _time_slope_group([(op, x, aux)], lo=lo, hi=hi, n=n,
+                             trials=trials)[0]
 
-    def make(k):
+
+def _time_slope_group(cases, *, lo=1, hi=5, n=6, trials=5):
+    """Slope-of-mins for SEVERAL ops with their samples interleaved
+    round-robin, so every candidate sees the same chip phases — the only
+    way a pairwise comparison (Pallas vs XLA) is meaningful when the
+    relay's speed shifts minute-to-minute.  ``cases`` is a list of
+    ``(op, x, aux)``; returns seconds-per-application per case."""
+
+    def make(op, k):
         @jax.jit
-        def run(v):
+        def run(v, *a):
             def body(c, _):
                 for _ in range(k):
-                    c = op(c)
+                    # the barrier ends producer fusion: each application
+                    # materializes its output, so K applications really
+                    # do K× the work (without it, XLA loop-fuses chains
+                    # of its own ops and the slope measures register
+                    # work — one run recorded a 26 TB/s "softmax")
+                    c = jax.lax.optimization_barrier(op(c, *a))
                 return c, None
             out, _ = jax.lax.scan(body, v, None, length=n)
             return out
         return run
 
-    run_lo, run_hi = make(lo), make(hi)
-    _fetch(run_lo(x))
-    _fetch(run_hi(x))
-    t_lo = t_hi = float("inf")
+    runs = []
+    for op, x, aux in cases:
+        r_lo, r_hi = make(op, lo), make(op, hi)
+        _fetch(r_lo(x, *aux))
+        _fetch(r_hi(x, *aux))
+        runs.append((r_lo, r_hi, x, aux))
+    mins = [[float("inf"), float("inf")] for _ in cases]
     for round_ in range(2):
         for _ in range(trials):
-            t0 = time.perf_counter()
-            _fetch(run_lo(x))
-            t_lo = min(t_lo, time.perf_counter() - t0)
-            t0 = time.perf_counter()
-            _fetch(run_hi(x))
-            t_hi = min(t_hi, time.perf_counter() - t0)
+            for i, (r_lo, r_hi, x, aux) in enumerate(runs):
+                t0 = time.perf_counter()
+                _fetch(r_lo(x, *aux))
+                mins[i][0] = min(mins[i][0], time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                _fetch(r_hi(x, *aux))
+                mins[i][1] = min(mins[i][1], time.perf_counter() - t0)
+        if all(m[1] > m[0] for m in mins):
+            break
+        # some slope degenerate (slow phase swallowed the hi samples):
+        # one more round before falling back
+    out = []
+    for t_lo, t_hi in mins:
         if t_hi > t_lo:
-            return (t_hi - t_lo) / ((hi - lo) * n)
-        # degenerate slope (a slow phase swallowed every hi sample):
-        # sample once more, then fall back below rather than clamp
-    # conservative fallback: absolute time of the hi run INCLUDING all
-    # fixed overheads — an upper bound on per-op time, so the derived
-    # throughput is a lower bound (noise can only make us look slower,
-    # never absurdly faster; a 1e-12 clamp here once produced
-    # quadrillion-TFLOPS entries in the record)
-    return t_hi / (hi * n)
+            out.append((t_hi - t_lo) / ((hi - lo) * n))
+        else:
+            # conservative fallback: absolute hi-run time INCLUDING all
+            # fixed overheads — an upper bound on per-op time, so the
+            # derived throughput is a lower bound (noise can only make
+            # us look slower; a 1e-12 clamp here once produced
+            # quadrillion-TFLOPS entries in the record)
+            out.append(t_hi / (hi * n))
+    return out
 
 
 def bench_matmul_roof():
@@ -109,7 +138,7 @@ def bench_matmul_roof():
     m = 8192
     a = jax.random.normal(jax.random.PRNGKey(0), (m, m), jnp.bfloat16)
     b = jax.random.normal(jax.random.PRNGKey(1), (m, m), jnp.bfloat16)
-    t = _time_slope(lambda x: (x @ b).astype(jnp.bfloat16), a,
+    t = _time_slope(lambda x, b: (x @ b).astype(jnp.bfloat16), a, b,
                     lo=1, hi=3, n=8, trials=3)
     return 2 * m ** 3 / t / 1e12
 
@@ -127,17 +156,18 @@ def bench_hbm_roof():
 
     rows, cols = 16384, 8192  # 512 MB fp32
     x = jax.random.normal(jax.random.PRNGKey(0), (rows, cols), jnp.float32)
-    block = 512
+    block = 256  # 256x2048 fp32 = 2 MB/block: well under VMEM with
+    bcols = 2048  # double buffering (512-row full-width blocks OOM'd it)
 
     def copy_kernel(x_ref, o_ref):
         o_ref[...] = x_ref[...]
 
-    def hbm_copy(v):
+    def hbm_copy(v):  # no aux operands; the carry is the only array
         return pl.pallas_call(
             copy_kernel,
-            grid=(rows // block,),
-            in_specs=[pl.BlockSpec((block, cols), lambda i: (i, 0))],
-            out_specs=pl.BlockSpec((block, cols), lambda i: (i, 0)),
+            grid=(rows // block, cols // bcols),
+            in_specs=[pl.BlockSpec((block, bcols), lambda i, j: (i, j))],
+            out_specs=pl.BlockSpec((block, bcols), lambda i, j: (i, j)),
             out_shape=jax.ShapeDtypeStruct((rows, cols), v.dtype),
             interpret=jax.default_backend() != "tpu",
         )(v)
@@ -235,13 +265,12 @@ def bench_gpt350m():
     1024) single-chip training throughput.
 
     Returns (tokens/sec, analytic model TFLOPS, analytic hw TFLOPS,
-    cost-analysis TFLOPS, remat_policy)."""
+    cost-analysis TFLOPS, remat_policy, top_ops)."""
+    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     from apex_tpu.transformer import parallel_state
     from apex_tpu.transformer.testing import GPTConfig, GPTModel
-
-    shard_map = jax.shard_map
 
     B = int(os.environ.get("BENCH_GPT_BATCH", "8"))
     remat_policy = os.environ.get("BENCH_GPT_REMAT", "full")
@@ -263,7 +292,10 @@ def bench_gpt350m():
                                 cfg.vocab_size)
     labels = jnp.roll(tokens, -1, axis=-1)
 
-    @jax.jit
+    # donation frees the old params/opt buffers for the step's temps —
+    # measured: grows the fit envelope (B=16 full-remat fits only with
+    # donation) at identical B=8 throughput
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_step(p, opt_state, t, l):
         def lossf(p):
             return shard_map(
@@ -288,6 +320,31 @@ def bench_gpt350m():
                                                  labels)
         final = float(loss)
         best_dt = min(best_dt, (time.perf_counter() - t0) / steps)
+    # pyprof-prof-stage parity: top ops of the step by MEASURED device
+    # time (profiling.top_ops_report) — the table that names the real
+    # time sinks, recorded for the tuning log in BASELINE.md.  Opt-in
+    # (BENCH_TOP_OPS=1): on the relay backend a failed profiler capture
+    # can poison the process with RESOURCE_EXHAUSTED for every
+    # subsequent dispatch, losing the rest of the record.
+    top_ops = []
+    if os.environ.get("BENCH_TOP_OPS", "0") == "1":
+        try:
+            # rebind through a closure: train_step donates its first two
+            # args, so repeated calls must chain the fresh outputs
+            state = {"p": params, "o": opt_state}
+
+            def prof_step(t, l):
+                state["p"], state["o"], loss = train_step(
+                    state["p"], state["o"], t, l)
+                return loss
+
+            ops = profiling.top_ops_report(prof_step, tokens, labels,
+                                           steps=2, top=3)
+            top_ops = [{"name": o.name[:80], "ms": round(o.total_ms, 2),
+                        "frac": round(o.frac_of_device, 3)} for o in ops]
+            params, opt_state = state["p"], state["o"]
+        except Exception as e:
+            top_ops = [{"error": repr(e)[:120]}]
     parallel_state.destroy_model_parallel()
     assert jnp.isfinite(final), f"gpt diverged: {final}"
     n_tok = B * GPT_SEQ
@@ -296,7 +353,7 @@ def bench_gpt350m():
                                with_remat=(remat_policy == "full"))
     return (n_tok / best_dt, model_fl / best_dt / 1e12,
             hw_fl / best_dt / 1e12, cost_flops / best_dt / 1e12,
-            remat_policy)
+            remat_policy, top_ops)
 
 
 # ---------------------------------------------------------------------------
@@ -314,11 +371,11 @@ def bench_attention_kernel(bh, s, d, block_q, block_k):
     fwd_flops = 4 * bh * s * s * d / 2  # causal
     bwd_flops = 2.5 * fwd_flops
 
-    def fwd(x):
+    def fwd(x, k, v):
         return flash_attention(x, k, v, causal=True,
                                block_q=block_q, block_k=block_k)
 
-    def naive(x):
+    def naive(x, k, v):
         s_ = jnp.einsum("bqd,bkd->bqk", x, k,
                         preferred_element_type=jnp.float32) / (d ** 0.5)
         s_ = jnp.where(jnp.tril(jnp.ones((s, s), bool)), s_, -1e30)
@@ -326,28 +383,35 @@ def bench_attention_kernel(bh, s, d, block_q, block_k):
             jnp.bfloat16), v, preferred_element_type=jnp.float32).astype(
             jnp.bfloat16)
 
-    def train(x):
+    def train(x, k, v):
         def loss(q_, k_, v_):
-            return jnp.sum(fwd_loss_target(q_, k_, v_))
-        def fwd_loss_target(q_, k_, v_):
-            return flash_attention(q_, k_, v_, causal=True,
-                                   block_q=block_q,
-                                   block_k=block_k).astype(jnp.float32) * 1e-3
+            return jnp.sum(flash_attention(
+                q_, k_, v_, causal=True, block_q=block_q,
+                block_k=block_k).astype(jnp.float32) * 1e-3)
         g = jax.grad(loss, argnums=(0, 1, 2))(x, k, v)
         return x + g[0].astype(x.dtype) * 1e-6
 
-    t_f = _time_slope(fwd, q, lo=1, hi=4, n=5)
-    t_fb = _time_slope(train, q, lo=1, hi=3, n=4)
+    # fwd and its naive rival interleave (phase-fair); bwd separate
+    naive_err = None
+    try:
+        t_f, t_n = _time_slope_group(
+            [(fwd, q, (k, v)), (naive, q, (k, v))], lo=1, hi=3, n=4)
+    except Exception as e:
+        # do NOT label this a structural naive-OOM win: transient relay
+        # failures land here too — record what actually happened and
+        # measure the kernel alone
+        naive_err = repr(e)[:120]
+        t_f = _time_slope(fwd, q, k, v, lo=1, hi=4, n=5)
+    t_fb = _time_slope(train, q, k, v, lo=1, hi=3, n=4)
     out = {
         "fwd_tflops": round(fwd_flops / t_f / 1e12, 1),
         "fwdbwd_tflops": round((fwd_flops + bwd_flops) / t_fb / 1e12, 1),
     }
-    try:
-        t_n = _time_slope(naive, q, lo=1, hi=3, n=4)
+    if naive_err is None:
         out["xla_naive_fwd_tflops"] = round(fwd_flops / t_n / 1e12, 1)
         out["fwd_speedup_vs_naive"] = round(t_n / t_f, 2)
-    except Exception as e:  # long-seq naive can OOM — structural win
-        out["xla_naive_fwd_tflops"] = f"OOM/{repr(e)[:60]}"
+    else:
+        out["xla_naive_error"] = naive_err
     return out
 
 
@@ -364,8 +428,10 @@ def bench_layernorm_kernel():
     b = jnp.zeros((cols,), jnp.float32)
     nbytes = rows * cols * 2
 
-    t_p = _time_slope(lambda v: _pallas_ln_fwd(v, w, b, 1e-5)[0], x)
-    t_x = _time_slope(lambda v: _xla_ln_fwd(v, w, b, 1e-5)[0], x)
+    t_p, t_x = _time_slope_group([
+        (lambda v, w, b: _pallas_ln_fwd(v, w, b, 1e-5)[0], x, (w, b)),
+        (lambda v, w, b: _xla_ln_fwd(v, w, b, 1e-5)[0], x, (w, b)),
+    ])
     out = {
         "fwd_pallas_gb_s": round(2 * nbytes / t_p / 1e9, 1),
         "fwd_xla_gb_s": round(2 * nbytes / t_x / 1e9, 1),
@@ -374,24 +440,24 @@ def bench_layernorm_kernel():
 
     # backward: the fused dgrad+dgamma+dbeta custom_vjp vs jax AD of the
     # naive formulation (what users get without the fused op)
-    def fused_bwd(v):
+    def fused_bwd(v, w, b):
         g = jax.grad(lambda xx: jnp.sum(
             layer_norm(xx, w, b).astype(jnp.float32)))(v)
         return g
 
-    def naive_ln(xx):
+    def naive_ln(xx, w, b):
         xf = xx.astype(jnp.float32)
         mu = jnp.mean(xf, -1, keepdims=True)
         var = jnp.mean((xf - mu) ** 2, -1, keepdims=True)
         return (((xf - mu) * jax.lax.rsqrt(var + 1e-5)) * w + b).astype(
             xx.dtype)
 
-    def ad_bwd(v):
+    def ad_bwd(v, w, b):
         return jax.grad(lambda xx: jnp.sum(
-            naive_ln(xx).astype(jnp.float32)))(v)
+            naive_ln(xx, w, b).astype(jnp.float32)))(v)
 
-    t_fb = _time_slope(fused_bwd, x, lo=1, hi=3, n=4)
-    t_ab = _time_slope(ad_bwd, x, lo=1, hi=3, n=4)
+    t_fb, t_ab = _time_slope_group(
+        [(fused_bwd, x, (w, b)), (ad_bwd, x, (w, b))], lo=1, hi=3, n=4)
     # fwd+bwd traffic ~ 4 passes over x (fwd read/write + bwd read x,g
     # write dx)
     out["bwd_fused_gb_s"] = round(4 * nbytes / t_fb / 1e9, 1)
@@ -416,9 +482,10 @@ def bench_softmax_kernel():
         sc = jnp.where(m, v.astype(jnp.float32), -1e30)
         return jax.nn.softmax(sc, -1).astype(v.dtype)
 
-    t_f = _time_slope(lambda v: fused(v, None), x, lo=1, hi=3, n=4)
-    t_n = _time_slope(naive, x, lo=1, hi=3, n=4)
-    nbytes = x.size * 2
+    t_f, t_n = _time_slope_group(
+        [(lambda v: fused(v, None), x, ()), (naive, x, ())],
+        lo=1, hi=3, n=4)  # tril mask is tiny, safe to close over
+    nbytes = x.size * 2  # read + write bf16, intermediates stay fused
     return {
         "fused_gb_s": round(2 * nbytes / t_f / 1e9, 1),
         "xla_naive_gb_s": round(2 * nbytes / t_n / 1e9, 1),
@@ -433,12 +500,12 @@ def bench_xentropy_kernel():
                                jnp.float32) * 2
     labels = jax.random.randint(jax.random.PRNGKey(1), (n,), 0, v)
 
-    def fused_step(x):
+    def fused_step(x, labels):
         g = jax.grad(lambda lg: jnp.mean(
             softmax_cross_entropy_loss(lg, labels)))(x)
         return x - g
 
-    def naive_step(x):
+    def naive_step(x, labels):
         def f(lg):
             lse = jax.nn.logsumexp(lg, axis=-1)
             nll = lse - jnp.take_along_axis(
@@ -446,12 +513,13 @@ def bench_xentropy_kernel():
             return jnp.mean(nll)
         return x - jax.grad(f)(x)
 
-    t_f = _time_slope(fused_step, logits, lo=1, hi=3, n=3)
-    t_n = _time_slope(naive_step, logits, lo=1, hi=3, n=3)
-    nbytes = logits.size * 4
+    t_f, t_n = _time_slope_group(
+        [(fused_step, logits, (labels,)), (naive_step, logits, (labels,))],
+        lo=1, hi=3, n=3)
+    # relative only, same rationale as bench_softmax_kernel
     return {
-        "fused_gb_s": round(3 * nbytes / t_f / 1e9, 1),
-        "xla_naive_gb_s": round(3 * nbytes / t_n / 1e9, 1),
+        "fused_us": round(t_f * 1e6, 1),
+        "xla_naive_us": round(t_n * 1e6, 1),
         "speedup": round(t_n / t_f, 2),
     }
 
@@ -464,65 +532,70 @@ def main():
 
     extras = {}
 
-    note("matmul roof...")
-    roof = bench_matmul_roof()
-    extras["matmul_roof_tflops"] = round(roof, 1)
-    note("hbm roof...")
-    hbm = bench_hbm_roof()
-    extras["hbm_roof_gb_s"] = round(hbm, 1)
+    def attempt(name, fn, retries=2):
+        """The relay's compile service fails transiently (HTTP 500 /
+        closed body); one lost microbench must not lose the record."""
+        for i in range(retries):
+            note(f"{name}..." if i == 0 else f"{name} (retry {i})...")
+            try:
+                return fn()
+            except Exception as e:
+                err = repr(e)[:200]
+        extras[f"{name}_error"] = err
+        return None
+
+    roof = attempt("matmul_roof", bench_matmul_roof)
+    if roof:
+        extras["matmul_roof_tflops"] = round(roof, 1)
+    hbm = attempt("hbm_roof", bench_hbm_roof)
+    if hbm:
+        extras["hbm_roof_gb_s"] = round(hbm, 1)
 
     note("resnet50...")
     ips, rn_tflops, rn_cost_tflops, rn_loss = bench_resnet()
     extras["resnet50_analytic_tflops"] = round(rn_tflops, 1)
     extras["resnet50_cost_analysis_tflops"] = round(rn_cost_tflops, 1)
     extras["resnet50_final_loss"] = round(rn_loss, 3)
-    extras["resnet50_mfu_vs_roof"] = round(rn_tflops / roof, 3)
+    if roof:
+        extras["resnet50_mfu_vs_roof"] = round(rn_tflops / roof, 3)
 
     if not FAST:
-        note("gpt350m...")
-        try:
-            tok_s, model_tf, hw_tf, cost_tf, policy = bench_gpt350m()
+        gpt = attempt("gpt350m", bench_gpt350m)
+        if gpt:
+            tok_s, model_tf, hw_tf, cost_tf, policy, top_ops = gpt
             extras["gpt350m_tokens_per_sec"] = round(tok_s, 0)
             extras["gpt350m_model_tflops"] = round(model_tf, 1)
             extras["gpt350m_hw_tflops"] = round(hw_tf, 1)
             extras["gpt350m_cost_analysis_tflops"] = round(cost_tf, 1)
             extras["gpt350m_remat_policy"] = policy
-            extras["gpt350m_mfu_vs_roof"] = round(model_tf / roof, 3)
-        except Exception as e:  # keep the headline alive
-            extras["gpt350m_error"] = repr(e)[:200]
+            extras["gpt350m_top_ops"] = top_ops
+            if roof:
+                extras["gpt350m_mfu_vs_roof"] = round(model_tf / roof, 3)
 
-        note("flash attention microbench (GPT shape)...")
-        try:
-            r = bench_attention_kernel(128, 1024, 64, 512, 512)
-            r["fwd_frac_of_roof"] = round(r["fwd_tflops"] / roof, 3)
+        r = attempt("flash_attention_s1024",
+                    lambda: bench_attention_kernel(128, 1024, 64, 512, 512))
+        if r:
+            if roof:
+                r["fwd_frac_of_roof"] = round(r["fwd_tflops"] / roof, 3)
             extras["flash_attention_s1024"] = r
-        except Exception as e:
-            extras["flash_attention_s1024_error"] = repr(e)[:200]
-        note("flash attention microbench (long seq)...")
-        try:
-            r = bench_attention_kernel(16, 4096, 128, 1024, 1024)
-            r["fwd_frac_of_roof"] = round(r["fwd_tflops"] / roof, 3)
+        r = attempt("flash_attention_s4096",
+                    lambda: bench_attention_kernel(16, 4096, 128, 1024, 1024))
+        if r:
+            if roof:
+                r["fwd_frac_of_roof"] = round(r["fwd_tflops"] / roof, 3)
             extras["flash_attention_s4096"] = r
-        except Exception as e:
-            extras["flash_attention_s4096_error"] = repr(e)[:200]
-        note("layer norm microbench...")
-        try:
-            r = bench_layernorm_kernel()
-            r["fwd_frac_of_hbm"] = round(
-                r["fwd_pallas_gb_s"] / max(hbm, 1e-9), 3)
+        r = attempt("layer_norm", bench_layernorm_kernel)
+        if r:
+            if hbm:
+                r["fwd_frac_of_hbm"] = round(
+                    r["fwd_pallas_gb_s"] / hbm, 3)
             extras["layer_norm"] = r
-        except Exception as e:
-            extras["layer_norm_error"] = repr(e)[:200]
-        note("softmax microbench...")
-        try:
-            extras["fused_softmax"] = bench_softmax_kernel()
-        except Exception as e:
-            extras["fused_softmax_error"] = repr(e)[:200]
-        note("xentropy microbench...")
-        try:
-            extras["xentropy"] = bench_xentropy_kernel()
-        except Exception as e:
-            extras["xentropy_error"] = repr(e)[:200]
+        r = attempt("fused_softmax", bench_softmax_kernel)
+        if r:
+            extras["fused_softmax"] = r
+        r = attempt("xentropy", bench_xentropy_kernel)
+        if r:
+            extras["xentropy"] = r
 
     baseline = None
     try:

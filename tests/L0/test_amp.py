@@ -196,3 +196,39 @@ class TestCastWrappers:
         assert lists.autocast_policy("relu") is None
         with pytest.raises(NotImplementedError):
             lists.autocast_policy("binary_cross_entropy")
+
+
+class TestOptimWrapper:
+    """Legacy amp.opt surface (reference apex/amp/opt.py:9-104):
+    per-loss scalers selected by loss_id, functional state."""
+
+    def test_two_losses_scale_independently(self):
+        from apex_tpu import amp, optimizers
+
+        params = {"w": jnp.ones((4,))}
+        wrapper = amp.OptimWrapper(optimizers.FusedSGD(lr=0.1), num_loss=2)
+        state = wrapper.init(params)
+
+        def loss_a(p, x):
+            return jnp.sum(p["w"] * x)
+
+        def loss_bad(p, x):
+            return jnp.sum(p["w"] * x) * jnp.inf  # always overflows
+
+        x = jnp.ones((4,))
+        (l0), g0, fin0 = wrapper.scaled_grad(loss_a, state, params, x,
+                                             loss_id=0)
+        params2, state = wrapper.step(state, params, g0, fin0, loss_id=0)
+        assert bool(fin0)
+        assert float(jnp.abs(params2["w"] - params["w"]).max()) > 0
+
+        (l1), g1, fin1 = wrapper.scaled_grad(loss_bad, state, params2, x,
+                                             loss_id=1)
+        params3, state = wrapper.step(state, params2, g1, fin1, loss_id=1)
+        assert not bool(fin1)
+        np.testing.assert_array_equal(np.asarray(params3["w"]),
+                                      np.asarray(params2["w"]))  # skipped
+        sd = wrapper.state_dict(state)
+        # loss 0's scaler untouched by loss 1's overflow; loss 1 halved
+        assert sd["scalers"][0]["loss_scale"] == 2.0 ** 16
+        assert sd["scalers"][1]["loss_scale"] == 2.0 ** 15

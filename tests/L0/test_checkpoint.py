@@ -295,9 +295,12 @@ def test_restore_without_target_handles_odd_keys(tmp_path):
     np.testing.assert_array_equal(np.asarray(out["plain"]), np.zeros((1,)))
 
 
-def test_colliding_keystrs_round_trip(tmp_path):
-    """Two distinct leaves whose mangled keystrs collide must both
-    survive save + restore (with and without target)."""
+def test_bracket_quote_keys_round_trip(tmp_path):
+    """Leaves whose dict keys contain quotes/brackets survive save +
+    restore (with and without target).  (jax keystr double-quotes such
+    keys so these do NOT actually collide; the save-side '#N' rename is
+    a defensive guard for any pytree whose keystrs do collide, and spec
+    association is keyed by structured path so it is rename-immune.)"""
     tree = {"x": {"y": jnp.ones((2,)) * 3}, "x']['y": jnp.ones((2,)) * 7}
     ckpt.save_checkpoint(str(tmp_path), tree, step=0)
     back, _ = ckpt.restore_checkpoint(str(tmp_path), target=tree)

@@ -445,3 +445,32 @@ class TestDropout:
         parallel_state.destroy_model_parallel()
         np.testing.assert_allclose(np.asarray(hs[0]), np.asarray(hs[1]),
                                    rtol=1e-5, atol=1e-6)
+
+    def test_bert_dropout_active_and_deterministic(self):
+        cfg = BertConfig(num_layers=2, hidden_size=32, num_attention_heads=4,
+                         vocab_size=VOCAB, max_position_embeddings=SEQ,
+                         tp_size=1, attention_dropout=0.3,
+                         hidden_dropout=0.25)
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel(1, 1)
+        model = BertModel(cfg)
+        params = model.shard_master(
+            model.init_master(jax.random.PRNGKey(0)), 0)
+        tokens = _tokens(jax.random.PRNGKey(1))
+        labels = _tokens(jax.random.PRNGKey(2))
+        amask = jnp.ones_like(tokens)
+
+        def loss(key):
+            def run(p, t, l):
+                losses, _ = model.apply(p, t, attention_mask=amask,
+                                        lm_labels=l, dropout_key=key)
+                return jnp.mean(losses)
+            return float(shard_map(run, mesh=mesh, in_specs=(P(), P(), P()),
+                                   out_specs=P(), check_rep=False)(
+                params, tokens, labels))
+
+        la = loss(jax.random.PRNGKey(3))
+        lb = loss(jax.random.PRNGKey(3))
+        lc = loss(jax.random.PRNGKey(4))
+        parallel_state.destroy_model_parallel()
+        assert la == lb and la != lc and np.isfinite(la)

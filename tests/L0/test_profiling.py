@@ -111,15 +111,27 @@ class TestTraceReport:
              "args": {"name": "/device:TPU:0"}},
             {"ph": "M", "name": "process_name", "pid": 9,
              "args": {"name": "python host"}},
-            {"ph": "X", "pid": 1, "name": "fusion.7", "dur": 300.0},
-            {"ph": "X", "pid": 1, "name": "fusion.7", "dur": 100.0},
-            {"ph": "X", "pid": 1, "name": "dot.3", "dur": 600.0},
+            # a container span (step/module lane) wrapping the real ops:
+            # must NOT double-count
+            {"ph": "X", "pid": 1, "ts": 0.0, "name": "module_span",
+             "dur": 2000.0},
+            {"ph": "X", "pid": 1, "ts": 10.0, "name": "fusion.7",
+             "dur": 300.0},
+            {"ph": "X", "pid": 1, "ts": 400.0, "name": "fusion.7",
+             "dur": 100.0},
+            {"ph": "X", "pid": 1, "ts": 600.0, "name": "dot.3",
+             "dur": 600.0},
+            # bare-number step lanes are skipped by name
+            {"ph": "X", "pid": 1, "ts": 0.0, "name": "7", "dur": 5000.0},
             # host event must be excluded when device pids exist
-            {"ph": "X", "pid": 9, "name": "hostwork", "dur": 9999.0},
+            {"ph": "X", "pid": 9, "ts": 0.0, "name": "hostwork",
+             "dur": 9999.0},
         ])
         ops = profiling.parse_trace_dir(str(tmp_path))
         names = {o.name: o for o in ops}
         assert "hostwork" not in names
+        assert "module_span" not in names   # container, not a leaf
+        assert "7" not in names             # step lane
         assert names["dot.3"].total_ms == pytest.approx(0.6)
         assert names["fusion.7"].calls == 2
         assert names["fusion.7"].total_ms == pytest.approx(0.4)
