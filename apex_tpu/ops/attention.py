@@ -67,6 +67,49 @@ def _masked_exp(s, m):
 
 
 # ---------------------------------------------------------------------------
+# In-kernel dropout: counter-based hash RNG (the reference FMHA's design —
+# cuRAND Philox keyed by per-element counters, fmha_fprop/dgrad kernels —
+# mapped to a murmur3-finalizer hash of (seed, batch-head, global row,
+# global col) in plain uint32 jnp ops, so the SAME bits are generated in
+# the forward kernel, both backward kernels, and the XLA fallback path,
+# on any backend, with zero mask storage.
+# ---------------------------------------------------------------------------
+
+
+def _keep_from_coords(rows, cols, b, seed, rate):
+    """keep = hash(seed, b, row, col) >= rate·2³², elementwise uint32."""
+    x = (rows.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+         ^ cols.astype(jnp.uint32) * jnp.uint32(0x85EBCA77))
+    x = x ^ (jnp.asarray(seed).astype(jnp.uint32)
+             + jnp.asarray(b).astype(jnp.uint32) * jnp.uint32(0x27D4EB2F))
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    thresh = jnp.uint32(min(int(rate * 2.0 ** 32), 2 ** 32 - 1))
+    return x >= thresh  # P[keep] = 1 - rate
+
+
+def _dropout_keep(seed, b, qi, ki, bq, bk, rate):
+    """Boolean keep-mask [bq, bk] for the score tile whose top-left corner
+    is global (qi, ki) of batch-head ``b``.  ``seed`` is a traced int32
+    scalar; ``rate`` is static.  Coordinates are GLOBAL, so any tiling
+    (forward, dq, dkv, or the untiled XLA path) replays the same bits."""
+    rows = qi + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = ki + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return _keep_from_coords(rows, cols, b, seed, rate)
+
+
+def _dropout_keep_full(seed, bh, sq, sk, rate):
+    """[bh, sq, sk] keep-mask, bitwise identical to the tiled kernels'
+    masks — the XLA fallback's dropout therefore matches the Pallas path
+    exactly on every backend."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bh, sq, sk), 1)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (bh, sq, sk), 2)
+    b = jax.lax.broadcasted_iota(jnp.int32, (bh, sq, sk), 0)
+    return _keep_from_coords(rows, cols, b, seed, rate)
+
+
+# ---------------------------------------------------------------------------
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
 
@@ -92,15 +135,17 @@ def _assemble_scores(q, k, qi, ki, *, scale, causal, sq, sk,
 
 
 def _make_fwd_kernel(*, scale, causal, block_q, block_k, sq, sk,
-                     has_mask, has_seg):
+                     has_mask, has_seg, dropout_rate):
     def kernel(*refs):
         it = iter(refs)
         q_ref, k_ref, v_ref = next(it), next(it), next(it)
         mask_ref = next(it) if has_mask else None
         segq_ref = next(it) if has_seg else None
         segk_ref = next(it) if has_seg else None
+        seed_ref = next(it) if dropout_rate > 0 else None
         o_ref, lse_ref = next(it), next(it)
 
+        bh_idx = pl.program_id(0)
         qi = pl.program_id(1) * block_q
         q = q_ref[0]  # [block_q, d]
         d = q.shape[-1]
@@ -132,7 +177,14 @@ def _make_fwd_kernel(*, scale, causal, block_q, block_k, sq, sk,
             m_new = jnp.maximum(m, jnp.max(s, axis=-1))
             p = _masked_exp(s, m_new[:, None])
             alpha = jnp.exp(m - m_new)
+            # l accumulates UNDROPPED p: normalization must match the
+            # softmax (dropout applies to the normalized probs)
             l_new = alpha * l + jnp.sum(p, axis=-1)
+            if dropout_rate > 0:
+                keep = _dropout_keep(seed_ref[0, 0], bh_idx, qi,
+                                     kb * block_k, block_q, block_k,
+                                     dropout_rate)
+                p = jnp.where(keep, p, 0.0) / (1.0 - dropout_rate)
             pv = jax.lax.dot_general(
                 p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -187,8 +239,17 @@ def _mask_seg_specs(mask_bias, seg_q, seg_k, block_q_spec, sk, gridded_q):
     return specs, args
 
 
-def _flash_fwd_pallas(q, k, v, mask_bias, seg_q, seg_k,
-                      scale, causal, block_q, block_k):
+def _seed_spec_arg(dropout_rate, dropout_seed):
+    """(specs, args) tail for the dropout seed: a (1, 1) int32 operand
+    every grid cell reads whole."""
+    if dropout_rate <= 0:
+        return [], []
+    seed = jnp.asarray(dropout_seed, jnp.int32).reshape(1, 1)
+    return [pl.BlockSpec((1, 1), lambda *_: (0, 0))], [seed]
+
+
+def _flash_fwd_pallas(q, k, v, mask_bias, seg_q, seg_k, dropout_seed,
+                      scale, causal, block_q, block_k, dropout_rate):
     """q [bh, sq, d], k/v [bh, sk, d] → (o [bh, sq, d], lse [bh, sq]).
 
     mask_bias: [mbh, sq, sk] additive (mbh ∈ {bh, 1}) or None.
@@ -207,15 +268,16 @@ def _flash_fwd_pallas(q, k, v, mask_bias, seg_q, seg_k,
     ]
     tail_specs, tail_args = _mask_seg_specs(
         mask_bias, seg_q, seg_k, block_q, sk, gridded_q=True)
+    seed_specs, seed_args = _seed_spec_arg(dropout_rate, dropout_seed)
 
     kernel = _make_fwd_kernel(
         scale=scale, causal=causal, block_q=block_q, block_k=block_k,
         sq=sq, sk=sk, has_mask=mask_bias is not None,
-        has_seg=seg_q is not None)
+        has_seg=seg_q is not None, dropout_rate=dropout_rate)
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh, sq // block_q),
-        in_specs=in_specs + tail_specs,
+        in_specs=in_specs + tail_specs + seed_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
             # lse carries a trailing singleton lane dim to satisfy the TPU
@@ -227,7 +289,7 @@ def _flash_fwd_pallas(q, k, v, mask_bias, seg_q, seg_k,
             jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
         ],
         interpret=use_interpret(),
-    )(q, k, v, *tail_args)
+    )(q, k, v, *tail_args, *seed_args)
     return o, lse[..., 0]
 
 
@@ -237,7 +299,7 @@ def _flash_fwd_pallas(q, k, v, mask_bias, seg_q, seg_k,
 
 
 def _make_dq_kernel(*, scale, causal, block_q, block_k, sq, sk,
-                    has_mask, has_seg):
+                    has_mask, has_seg, dropout_rate):
     def kernel(*refs):
         it = iter(refs)
         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (
@@ -245,8 +307,10 @@ def _make_dq_kernel(*, scale, causal, block_q, block_k, sq, sk,
         mask_ref = next(it) if has_mask else None
         segq_ref = next(it) if has_seg else None
         segk_ref = next(it) if has_seg else None
+        seed_ref = next(it) if dropout_rate > 0 else None
         dq_ref = next(it)
 
+        bh_idx = pl.program_id(0)
         qi = pl.program_id(1) * block_q
         q = q_ref[0]
         d = q.shape[-1]
@@ -275,6 +339,14 @@ def _make_dq_kernel(*, scale, causal, block_q, block_k, sq, sk,
             dp = jax.lax.dot_general(
                 do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
+            if dropout_rate > 0:
+                # replay the forward's keep-mask: dL/dP gets the mask and
+                # the 1/(1-r) scale; delta already includes them via
+                # rowsum(dO ∘ O)
+                keep = _dropout_keep(seed_ref[0, 0], bh_idx, qi,
+                                     kb * block_k, block_q, block_k,
+                                     dropout_rate)
+                dp = jnp.where(keep, dp, 0.0) / (1.0 - dropout_rate)
             ds = p * (dp - delta[:, None]) * scale
             return dq + jax.lax.dot_general(
                 ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -288,7 +360,7 @@ def _make_dq_kernel(*, scale, causal, block_q, block_k, sq, sk,
 
 
 def _make_dkv_kernel(*, scale, causal, block_q, block_k, sq, sk,
-                     has_mask, has_seg):
+                     has_mask, has_seg, dropout_rate):
     def kernel(*refs):
         it = iter(refs)
         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (
@@ -296,8 +368,10 @@ def _make_dkv_kernel(*, scale, causal, block_q, block_k, sq, sk,
         mask_ref = next(it) if has_mask else None
         segq_ref = next(it) if has_seg else None
         segk_ref = next(it) if has_seg else None
+        seed_ref = next(it) if dropout_rate > 0 else None
         dk_ref, dv_ref = next(it), next(it)
 
+        bh_idx = pl.program_id(0)
         ki = pl.program_id(1) * block_k
         k = k_ref[0]
         v = v_ref[0]
@@ -327,12 +401,23 @@ def _make_dkv_kernel(*, scale, causal, block_q, block_k, sq, sk,
                        if has_seg else None),
                 seg_k=seg_k)
             p = _masked_exp(s, lse[:, None])
-            dv = dv + jax.lax.dot_general(
-                p.astype(do_ref.dtype), do.astype(do_ref.dtype),
-                (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
             dp = jax.lax.dot_general(
                 do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if dropout_rate > 0:
+                # same (row, col) coordinates as the forward tile at
+                # (qb*block_q, ki) — the hash replays bit-exactly
+                keep = _dropout_keep(seed_ref[0, 0], bh_idx,
+                                     qb * block_q, ki, block_q, block_k,
+                                     dropout_rate)
+                inv = 1.0 / (1.0 - dropout_rate)
+                p_drop = jnp.where(keep, p, 0.0) * inv
+                dp = jnp.where(keep, dp, 0.0) * inv
+            else:
+                p_drop = p
+            dv = dv + jax.lax.dot_general(
+                p_drop.astype(do_ref.dtype), do.astype(do_ref.dtype),
+                (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
             ds = p * (dp - delta[:, None]) * scale
             dk = dk + jax.lax.dot_general(
@@ -349,8 +434,9 @@ def _make_dkv_kernel(*, scale, causal, block_q, block_k, sq, sk,
     return kernel
 
 
-def _flash_bwd_pallas(q, k, v, mask_bias, seg_q, seg_k, o, lse, do,
-                      scale, causal, block_q, block_k):
+def _flash_bwd_pallas(q, k, v, mask_bias, seg_q, seg_k, dropout_seed,
+                      o, lse, do, scale, causal, block_q, block_k,
+                      dropout_rate):
     """Returns (dq, dk, dv) in input dtypes."""
     bh, sq, d = q.shape
     sk = k.shape[1]
@@ -361,6 +447,7 @@ def _flash_bwd_pallas(q, k, v, mask_bias, seg_q, seg_k, o, lse, do,
     lse3 = lse[..., None]
     has_mask = mask_bias is not None
     has_seg = seg_q is not None
+    seed_specs, seed_args = _seed_spec_arg(dropout_rate, dropout_seed)
 
     # ---- dq: grid over q blocks ----
     in_specs = [
@@ -376,13 +463,14 @@ def _flash_bwd_pallas(q, k, v, mask_bias, seg_q, seg_k, o, lse, do,
     dq = pl.pallas_call(
         _make_dq_kernel(scale=scale, causal=causal, block_q=block_q,
                         block_k=block_k, sq=sq, sk=sk,
-                        has_mask=has_mask, has_seg=has_seg),
+                        has_mask=has_mask, has_seg=has_seg,
+                        dropout_rate=dropout_rate),
         grid=(bh, sq // block_q),
-        in_specs=in_specs + tail_specs,
+        in_specs=in_specs + tail_specs + seed_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=use_interpret(),
-    )(q, k, v, do, lse3, delta, *tail_args)
+    )(q, k, v, do, lse3, delta, *tail_args, *seed_args)
 
     # ---- dk/dv: grid over k blocks (q extent taken whole) ----
     in_specs2 = [
@@ -398,9 +486,10 @@ def _flash_bwd_pallas(q, k, v, mask_bias, seg_q, seg_k, o, lse, do,
     dk, dv = pl.pallas_call(
         _make_dkv_kernel(scale=scale, causal=causal, block_q=block_q,
                          block_k=block_k, sq=sq, sk=sk,
-                         has_mask=has_mask, has_seg=has_seg),
+                         has_mask=has_mask, has_seg=has_seg,
+                         dropout_rate=dropout_rate),
         grid=(bh, sk // block_k),
-        in_specs=in_specs2 + tail_specs2,
+        in_specs=in_specs2 + tail_specs2 + seed_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
@@ -410,7 +499,7 @@ def _flash_bwd_pallas(q, k, v, mask_bias, seg_q, seg_k, o, lse, do,
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
         interpret=use_interpret(),
-    )(q, k, v, do, lse3, delta, *tail_args2)
+    )(q, k, v, do, lse3, delta, *tail_args2, *seed_args)
     return dq, dk, dv
 
 
@@ -432,7 +521,8 @@ def _apply_masks(s, mask_bias, seg_q, seg_k, causal):
     return s
 
 
-def _blockwise_fwd_xla(q, k, v, scale, causal, mask_bias, seg_q, seg_k):
+def _blockwise_fwd_xla(q, k, v, scale, causal, mask_bias, seg_q, seg_k,
+                       dropout_seed=None, dropout_rate=0.0):
     """Plain-XLA forward with identical math (used off-TPU and for shapes
     below the TPU tiling grain — where the S×S score matrix is small)."""
     s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
@@ -441,19 +531,26 @@ def _blockwise_fwd_xla(q, k, v, scale, causal, mask_bias, seg_q, seg_k):
     m = jnp.max(s, axis=-1)
     p = _masked_exp(s, m[..., None])
     l = jnp.sum(p, axis=-1)
-    o = jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32))
+    if dropout_rate > 0:
+        keep = _dropout_keep_full(dropout_seed, *p.shape, dropout_rate)
+        pv = jnp.where(keep, p, 0.0) / (1.0 - dropout_rate)
+    else:
+        pv = p
+    o = jnp.einsum("bqk,bkd->bqd", pv, v.astype(jnp.float32))
     o = o / jnp.where(l == 0, 1.0, l)[..., None]
     lse = jnp.where(l == 0, _NEG_INF, m + jnp.log(jnp.where(l == 0, 1.0, l)))
     return o.astype(q.dtype), lse
 
 
 def _blockwise_bwd_xla(q, k, v, mask_bias, seg_q, seg_k, o, lse, do,
-                       scale, causal, block_k):
+                       scale, causal, block_k,
+                       dropout_seed=None, dropout_rate=0.0):
     """XLA backward: lax.scan over k blocks, S×block_k live at a time."""
     q32, k32, v32 = (t.astype(jnp.float32) for t in (q, k, v))
     do32 = do.astype(jnp.float32)
     delta = jnp.sum(do32 * o.astype(jnp.float32), axis=-1)  # [bh, sq]
     sq, sk = q.shape[1], k.shape[1]
+    bh = q.shape[0]
     bk = min(block_k, sk)
     n_kb = sk // bk if sk % bk == 0 else 1
     if sk % bk != 0:
@@ -475,8 +572,20 @@ def _blockwise_bwd_xla(q, k, v, mask_bias, seg_q, seg_k, o, lse, do,
             cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (sq, bk), 1)
             s = jnp.where((rows + (sk - sq))[None] >= cols[None], s, _NEG_INF)
         p = _masked_exp(s, lse[..., None])
-        dv = jnp.einsum("bqk,bqd->bkd", p, do32)
         dp = jnp.einsum("bqd,bkd->bqk", do32, vs)
+        if dropout_rate > 0:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bh, sq, bk), 1)
+            cols = kb * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bh, sq, bk), 2)
+            bb = jax.lax.broadcasted_iota(jnp.int32, (bh, sq, bk), 0)
+            keep = _keep_from_coords(rows, cols, bb, dropout_seed,
+                                     dropout_rate)
+            inv = 1.0 / (1.0 - dropout_rate)
+            p_drop = jnp.where(keep, p, 0.0) * inv
+            dp = jnp.where(keep, dp, 0.0) * inv
+        else:
+            p_drop = p
+        dv = jnp.einsum("bqk,bqd->bkd", p_drop, do32)
         ds = p * (dp - delta[..., None]) * scale
         dk = jnp.einsum("bqk,bqd->bkd", ds, q32)
         dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds, ks)
@@ -505,45 +614,50 @@ def _pallas_ok(q, k, mask_bias, block_q, block_k):
     return True
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9))
-def _flash_attention(q, k, v, mask_bias, seg_q, seg_k,
-                     scale, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
+def _flash_attention(q, k, v, mask_bias, seg_q, seg_k, dropout_seed,
+                     scale, causal, block_q, block_k, dropout_rate):
     o, _ = _flash_fwd_dispatch(q, k, v, mask_bias, seg_q, seg_k,
-                               scale, causal, block_q, block_k)
+                               dropout_seed, scale, causal, block_q,
+                               block_k, dropout_rate)
     return o
 
 
-def _flash_fwd_dispatch(q, k, v, mask_bias, seg_q, seg_k,
-                        scale, causal, block_q, block_k):
+def _flash_fwd_dispatch(q, k, v, mask_bias, seg_q, seg_k, dropout_seed,
+                        scale, causal, block_q, block_k, dropout_rate):
     if _pallas_ok(q, k, mask_bias, block_q, block_k):
         return _flash_fwd_pallas(q, k, v, mask_bias, seg_q, seg_k,
-                                 scale, causal, block_q, block_k)
+                                 dropout_seed, scale, causal, block_q,
+                                 block_k, dropout_rate)
     return _blockwise_fwd_xla(q, k, v, scale, causal, mask_bias,
-                              seg_q, seg_k)
+                              seg_q, seg_k, dropout_seed, dropout_rate)
 
 
-def _flash_fwd_rule(q, k, v, mask_bias, seg_q, seg_k,
-                    scale, causal, block_q, block_k):
+def _flash_fwd_rule(q, k, v, mask_bias, seg_q, seg_k, dropout_seed,
+                    scale, causal, block_q, block_k, dropout_rate):
     o, lse = _flash_fwd_dispatch(q, k, v, mask_bias, seg_q, seg_k,
-                                 scale, causal, block_q, block_k)
-    return o, (q, k, v, mask_bias, seg_q, seg_k, o, lse)
+                                 dropout_seed, scale, causal, block_q,
+                                 block_k, dropout_rate)
+    return o, (q, k, v, mask_bias, seg_q, seg_k, dropout_seed, o, lse)
 
 
-def _flash_bwd_rule(scale, causal, block_q, block_k, res, do):
-    q, k, v, mask_bias, seg_q, seg_k, o, lse = res
+def _flash_bwd_rule(scale, causal, block_q, block_k, dropout_rate,
+                    res, do):
+    q, k, v, mask_bias, seg_q, seg_k, dropout_seed, o, lse = res
     if _pallas_ok(q, k, mask_bias, block_q, block_k):
         dq, dk, dv = _flash_bwd_pallas(
-            q, k, v, mask_bias, seg_q, seg_k, o, lse, do,
-            scale, causal, block_q, block_k)
+            q, k, v, mask_bias, seg_q, seg_k, dropout_seed, o, lse, do,
+            scale, causal, block_q, block_k, dropout_rate)
     else:
         dq, dk, dv = _blockwise_bwd_xla(
             q, k, v, mask_bias, seg_q, seg_k, o, lse, do,
-            scale, causal, block_k)
+            scale, causal, block_k, dropout_seed, dropout_rate)
     dmask = None if mask_bias is None else jnp.zeros_like(mask_bias)
     f0 = jax.dtypes.float0
     dsegq = None if seg_q is None else np.zeros(seg_q.shape, f0)
     dsegk = None if seg_k is None else np.zeros(seg_k.shape, f0)
-    return (dq, dk, dv, dmask, dsegq, dsegk)
+    dseed = np.zeros((), f0)  # int32 scalar: symbolic-zero cotangent
+    return (dq, dk, dv, dmask, dsegq, dsegk, dseed)
 
 
 _flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -565,8 +679,18 @@ def flash_attention(
     block_q: int = 512,
     block_k: int = 1024,
     mask_is_constant: bool = True,
+    dropout_rate: float = 0.0,
+    dropout_seed: Optional[Union[int, jnp.ndarray]] = None,
 ) -> jnp.ndarray:
     """Fused attention over [b, h, s, d] (or [bh, s, d]) tensors.
+
+    ``dropout_rate`` > 0 applies attention-probability dropout INSIDE the
+    kernels (the reference FMHA's Philox in-kernel dropout,
+    fmha_api.cpp p_dropout): masks come from a counter-based hash of
+    (seed, batch-head, row, col), replayed bit-exactly in the backward
+    kernels and the XLA fallback — nothing is stored.  ``dropout_seed``
+    (int or traced int32 scalar) selects the stream; derive it per step
+    and per TP rank (see tensor_parallel.random) for training.
 
     Drop-in for the reference's ``fmha.FMHAFun`` (fmha.py:33) and the core
     of every ``fast_*_multihead_attn`` — without its seq-len/head-dim
@@ -610,17 +734,22 @@ def flash_attention(
         squeeze = (b, h)
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    rate = float(dropout_rate)
+    if rate > 0 and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 requires dropout_seed")
+    seed = jnp.asarray(dropout_seed if dropout_seed is not None else 0,
+                       jnp.int32)
     if mask_bias is not None and not mask_is_constant:
         # differentiable-bias path: same math, no custom_vjp, so AD
         # derives d(mask_bias) — the kernels only handle constant masks
         o, _ = _blockwise_fwd_xla(q, k, v, float(scale), bool(causal),
-                                  mask_bias, seg_q, seg_k)
+                                  mask_bias, seg_q, seg_k, seed, rate)
     else:
         if mask_bias is not None:
             mask_bias = jax.lax.stop_gradient(mask_bias)
-        o = _flash_attention(q, k, v, mask_bias, seg_q, seg_k,
+        o = _flash_attention(q, k, v, mask_bias, seg_q, seg_k, seed,
                              float(scale), bool(causal),
-                             int(block_q), int(block_k))
+                             int(block_q), int(block_k), rate)
     if squeeze:
         b, h = squeeze
         o = o.reshape(b, h, o.shape[1], o.shape[2])

@@ -121,9 +121,16 @@ class SwitchMLP:
         pos = jnp.sum(pos * onehot, axis=-1)                     # [T]
         keep = pos < C
 
-        # Switch aux loss: E * sum_e(frac_tokens_e * mean_prob_e)
+        # Switch aux loss: E * sum_e(frac_tokens_e * mean_prob_e).
+        # Under expert parallelism the statistics are averaged over the
+        # axis so every rank adds the SAME aux term — the gate weight is
+        # replicated, and a rank-local term would give each replica a
+        # different gradient and silently desync them after one step.
         frac = jnp.mean(onehot.astype(jnp.float32), axis=0)
         mean_p = jnp.mean(probs, axis=0)
+        if axis_name is not None and world > 1:
+            frac = jax.lax.pmean(frac, axis_name)
+            mean_p = jax.lax.pmean(mean_p, axis_name)
         aux_loss = E * jnp.sum(frac * mean_p)
 
         disp = jnp.zeros((E, C, H), h.dtype)

