@@ -140,8 +140,8 @@ class BertModel:
         am = None
         if attention_mask is not None:
             am = ~attention_mask[:, None, None, :].astype(bool)
-        h = self.transformer.apply(params["transformer"], h, am,
-                                   dropout_key=dropout_key)
+        h, _aux = self.transformer.apply(params["transformer"], h, am,
+                                         dropout_key=dropout_key)
 
         binary_logits = None
         if self.cfg.add_binary_head and "binary_head" in params:

@@ -86,6 +86,14 @@ class GPTConfig:
     # (jax.checkpoint_policies.dots_saveable) — near-zero recompute
     # flops at ~4× the activation footprint of "full"
     remat_policy: str = "full"
+    # Mixture-of-Experts: num_experts > 0 replaces every layer's MLP
+    # with a Switch-routed expert MLP (apex_tpu.transformer.moe) —
+    # experts replicated across TP; shard them over an expert mesh axis
+    # by using SwitchMLP directly.  aux loss (load balancing) is folded
+    # into the returned per-token losses so mean(losses) includes it.
+    num_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_coeff: float = 1e-2
     # flash kernel tile sizes (512² measured best for fwd+bwd at the
     # GPT-350M shape bh=128 s=1024 d=64; the 512/1024 library defaults
     # favor long sequences)
@@ -245,12 +253,22 @@ def _hidden_dropout(x, cfg, key):
 
 
 class ParallelTransformerLayer:
-    """Pre-LN block (reference standalone_gpt.py:575-709)."""
+    """Pre-LN block (reference standalone_gpt.py:575-709); with
+    ``cfg.num_experts > 0`` the MLP is a Switch-routed expert MLP."""
 
     def __init__(self, cfg: GPTConfig):
         self.cfg = cfg
         self.attention = ParallelAttention(cfg)
-        self.mlp = ParallelMLP(cfg)
+        if cfg.num_experts > 0:
+            from apex_tpu.transformer.moe import MoEConfig, SwitchMLP
+
+            self.mlp = SwitchMLP(MoEConfig(
+                hidden_size=cfg.hidden_size, ffn_hidden_size=cfg.ffn,
+                num_experts=cfg.num_experts,
+                capacity_factor=cfg.moe_capacity_factor,
+                init_method_std=cfg.init_method_std))
+        else:
+            self.mlp = ParallelMLP(cfg)
 
     def init_master(self, key):
         k1, k2 = jax.random.split(key)
@@ -264,14 +282,22 @@ class ParallelTransformerLayer:
         }
 
     def shard_master(self, master, rank):
+        if self.cfg.num_experts > 0:
+            # experts are replicated across TP (shard them over an
+            # expert axis with SwitchMLP.shard_master directly)
+            mlp = master["mlp"]
+        else:
+            mlp = self.mlp.shard_master(master["mlp"], rank)
         return {
             "input_layernorm": master["input_layernorm"],
             "attention": self.attention.shard_master(master["attention"], rank),
             "post_attention_layernorm": master["post_attention_layernorm"],
-            "mlp": self.mlp.shard_master(master["mlp"], rank),
+            "mlp": mlp,
         }
 
     def apply(self, params, h, attention_mask=None, dropout_key=None):
+        """Returns ``(h, aux)`` — ``aux`` is the MoE load-balancing loss
+        (0.0 for the dense MLP)."""
         cfg = self.cfg
         eps = cfg.layernorm_epsilon
         k_attn = k_h1 = k_h2 = None
@@ -285,8 +311,14 @@ class ParallelTransformerLayer:
         h = h + _hidden_dropout(attn, cfg, k_h1)
         ln2 = layer_norm(h, params["post_attention_layernorm"]["weight"],
                          params["post_attention_layernorm"]["bias"], eps=eps)
-        return h + _hidden_dropout(self.mlp.apply(params["mlp"], ln2),
-                                   cfg, k_h2)
+        if cfg.num_experts > 0:
+            b, s, hid = ln2.shape
+            out, aux = self.mlp.apply(params["mlp"], ln2.reshape(b * s, hid))
+            out = out.reshape(b, s, hid).astype(h.dtype)
+        else:
+            out, aux = self.mlp.apply(params["mlp"], ln2), jnp.zeros((),
+                                                                    jnp.float32)
+        return h + _hidden_dropout(out, cfg, k_h2), aux
 
 
 class ParallelTransformer:
@@ -316,12 +348,16 @@ class ParallelTransformer:
         return {"layers": shard(master["layers"])}
 
     def apply(self, params, h, attention_mask=None, dropout_key=None):
+        """Returns ``(h, aux)``; ``aux`` sums the layers' MoE
+        load-balancing losses (0.0 for dense MLPs)."""
         def body(carry, xs):
+            hidden, aux_sum = carry
             layer_params, idx = xs
             k = (None if dropout_key is None
                  else jax.random.fold_in(dropout_key, idx))
-            return self.layer.apply(layer_params, carry, attention_mask,
-                                    dropout_key=k), None
+            hidden, aux = self.layer.apply(layer_params, hidden,
+                                           attention_mask, dropout_key=k)
+            return (hidden, aux_sum + aux), None
 
         if self.cfg.remat:
             # save only layer boundaries; recompute inside each layer on
@@ -334,10 +370,10 @@ class ParallelTransformer:
             policy = (jax.checkpoint_policies.dots_saveable
                       if self.cfg.remat_policy == "dots" else None)
             body = jax.checkpoint(body, policy=policy)
-        h, _ = jax.lax.scan(body, h,
-                            (params["layers"],
-                             jnp.arange(self.num_layers)))
-        return h
+        (h, aux), _ = jax.lax.scan(
+            body, (h, jnp.zeros((), jnp.float32)),
+            (params["layers"], jnp.arange(self.num_layers)))
+        return h, aux
 
 
 class GPTModel:
@@ -409,12 +445,20 @@ class GPTModel:
         tracker discipline, random.py:193-221)."""
         h = self.embed(params, tokens)
         h = embedding_dropout(h, self.cfg, dropout_key)
-        h = self.transformer.apply(params["transformer"], h, attention_mask,
-                                   dropout_key=dropout_key)
+        h, aux = self.transformer.apply(params["transformer"], h,
+                                        attention_mask,
+                                        dropout_key=dropout_key)
         logits_local = self.head_logits_local(params, h)
         if labels is None:
             return logits_local
-        return vocab_parallel_cross_entropy(logits_local, labels)
+        losses = vocab_parallel_cross_entropy(logits_local, labels)
+        if self.cfg.num_experts > 0:
+            # fold the MoE load-balancing term in per-token so that
+            # mean(losses) == CE_mean + coeff * aux (the Megatron
+            # convention of adding aux to the scalar loss)
+            losses = losses + (self.cfg.moe_aux_loss_coeff * aux
+                               ).astype(losses.dtype)
+        return losses
 
     __call__ = apply
 
@@ -448,7 +492,11 @@ def make_gpt_stage_fns(cfg: GPTConfig, n_stages: int
         s = parallel_state.get_pipeline_model_parallel_rank()
         embedded = model.embed(params, mb["tokens"])
         h = jnp.where(s == 0, embedded, h_in.astype(embedded.dtype))
-        return model.transformer.apply(params["transformer"], h)
+        # MoE aux is dropped under pipelining (stage outputs are a single
+        # hidden tensor); use MoE with TP/DP, not PP, or thread a custom
+        # stage contract
+        h, _aux = model.transformer.apply(params["transformer"], h)
+        return h
 
     def loss_fn(params, h_out, mb):
         logits_local = model.head_logits_local(params, h_out)
