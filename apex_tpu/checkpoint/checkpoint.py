@@ -197,6 +197,21 @@ def save_checkpoint(
     leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
     spec_map = _spec_map(shardings, tree) if shardings is not None else {}
 
+    # _path_parts stringifies key components, so exotic pytrees can alias
+    # (DictKey('0') vs SequenceKey(0), int key 0 vs str '0').  An aliased
+    # path tuple would silently bind the wrong sharding spec or restore
+    # leaf — refuse at save time instead (ADVICE r3).
+    seen_paths = {}
+    for path, _ in leaves:
+        pt = tuple(_path_parts(path))
+        if pt in seen_paths:
+            raise ValueError(
+                f"checkpoint path collision: {_keystr(path)} and "
+                f"{seen_paths[pt]} both map to path tuple {pt} — rename "
+                "the colliding keys (e.g. avoid int and str keys that "
+                "stringify identically)")
+        seen_paths[pt] = _keystr(path)
+
     manifest = {"step": int(step), "format": 1, "leaves": {}}
     arrays = {}
     for path, leaf in leaves:

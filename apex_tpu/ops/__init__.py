@@ -33,6 +33,9 @@ from apex_tpu.ops.fused_softmax import (  # noqa: F401
     scaled_upper_triang_masked_softmax,
 )
 from apex_tpu.ops.mlp import MLP, mlp  # noqa: F401
+from apex_tpu.ops.fused_linear_xent import (  # noqa: F401
+    fused_linear_cross_entropy,
+)
 from apex_tpu.ops.xentropy import (  # noqa: F401
     SoftmaxCrossEntropyLoss,
     softmax_cross_entropy_loss,

@@ -91,7 +91,9 @@ def _keep_from_coords(rows, cols, b, seed, rate):
     x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
     x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
     x = x ^ (x >> 16)
-    thresh = jnp.uint32(min(int(rate * 2.0 ** 32), 2 ** 32 - 1))
+    # round, don't truncate: a tiny positive rate must not silently
+    # become a no-op threshold of 0 (ADVICE r3)
+    thresh = jnp.uint32(min(round(rate * 2.0 ** 32), 2 ** 32 - 1))
     return x >= thresh  # P[keep] = 1 - rate
 
 
@@ -205,6 +207,70 @@ def _make_fwd_kernel(*, scale, causal, block_q, block_k, sq, sk,
     return kernel
 
 
+def _make_fwd_kernel_split(*, scale, causal, block_q, block_k, sq, sk,
+                           has_mask, has_seg, dropout_rate):
+    """Split-merge forward: per-k-block LOCAL softmax partials combined
+    once at the end — no serialized rescale chain between k blocks, so
+    the MXU dots of different blocks pipeline independently.  Measured
+    0.524 vs 0.615 ms (+15%) at the GPT-350M shape.  Used when the k
+    extent is at most two blocks; for more blocks the unrolled partials
+    bloat the kernel and the online (carry) form wins."""
+    n_kb = sk // block_k
+
+    def kernel(*refs):
+        it = iter(refs)
+        q_ref, k_ref, v_ref = next(it), next(it), next(it)
+        mask_ref = next(it) if has_mask else None
+        segq_ref = next(it) if has_seg else None
+        segk_ref = next(it) if has_seg else None
+        seed_ref = next(it) if dropout_rate > 0 else None
+        o_ref, lse_ref = next(it), next(it)
+
+        bh_idx = pl.program_id(0)
+        qi = pl.program_id(1) * block_q
+        q = q_ref[0]
+        seg_q = segq_ref[0, :, 0] if has_seg else None
+
+        parts = []
+        for kb in range(n_kb):
+            ki = kb * block_k
+            k = k_ref[0, pl.ds(ki, block_k), :]
+            v = v_ref[0, pl.ds(ki, block_k), :]
+            s = _assemble_scores(
+                q, k, qi, ki, scale=scale, causal=causal, sq=sq, sk=sk,
+                mask=(mask_ref[0, :, pl.ds(ki, block_k)]
+                      if has_mask else None),
+                seg_q=seg_q,
+                seg_k=(segk_ref[0, pl.ds(ki, block_k), 0]
+                       if has_seg else None))
+            m_i = jnp.max(s, axis=-1)
+            p = _masked_exp(s, m_i[:, None])
+            l_i = jnp.sum(p, axis=-1)
+            if dropout_rate > 0:
+                keep = _dropout_keep(seed_ref[0, 0], bh_idx, qi, ki,
+                                     block_q, block_k, dropout_rate)
+                p = jnp.where(keep, p, 0.0) / (1.0 - dropout_rate)
+            acc_i = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            parts.append((m_i, l_i, acc_i))
+
+        m = parts[0][0]
+        for m_i, _, _ in parts[1:]:
+            m = jnp.maximum(m, m_i)
+        l = jnp.zeros_like(m)
+        acc = jnp.zeros_like(parts[0][2])
+        for m_i, l_i, acc_i in parts:
+            a = jnp.where(m_i <= _NEG_INF / 2, 0.0, jnp.exp(m_i - m))
+            l = l + a * l_i
+            acc = acc + a[:, None] * acc_i
+        l_safe = jnp.where(l == 0, 1.0, l)
+        o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(l == 0, _NEG_INF, m + jnp.log(l_safe))[:, None]
+
+    return kernel
+
+
 def _mask_seg_specs(mask_bias, seg_q, seg_k, block_q_spec, sk, gridded_q):
     """in_specs/args tail for the optional mask + segment inputs.
 
@@ -276,7 +342,9 @@ def _flash_fwd_pallas(q, k, v, mask_bias, seg_q, seg_k, dropout_seed,
         mask_bias, seg_q, seg_k, block_q, sk, gridded_q=True)
     seed_specs, seed_args = _seed_spec_arg(dropout_rate, dropout_seed)
 
-    kernel = _make_fwd_kernel(
+    make = (_make_fwd_kernel_split if sk // block_k <= 2
+            else _make_fwd_kernel)
+    kernel = make(
         scale=scale, causal=causal, block_q=block_q, block_k=block_k,
         sq=sq, sk=sk, has_mask=mask_bias is not None,
         has_seg=seg_q is not None, dropout_rate=dropout_rate)
@@ -712,6 +780,8 @@ def flash_attention(
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     rate = float(dropout_rate)
+    if not 0.0 <= rate < 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1), got {rate}")
     if rate > 0 and dropout_seed is None:
         raise ValueError("dropout_rate > 0 requires dropout_seed")
     seed = jnp.asarray(dropout_seed if dropout_seed is not None else 0,

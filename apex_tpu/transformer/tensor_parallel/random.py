@@ -111,7 +111,15 @@ def model_parallel_dropout_key(key: jax.Array,
     key = jax.random.fold_in(key, 2718)
     try:
         rank = jax.lax.axis_index(axis_name)
-    except NameError:
+    except Exception as e:  # unbound axis — tolerate the exception TYPE
+        # changing across jax versions (today NameError), but only for
+        # errors that actually say the axis is unbound: silently folding
+        # rank 0 on every rank would drop identical elements on
+        # TP-sharded activations, the exact bug this discipline prevents
+        # (guarded by the TP mask property test)
+        unbound = "unbound axis" in str(e).lower()
+        if not unbound and not isinstance(e, NameError):
+            raise  # unrelated failure: do not mask it as "unbound"
         rank = 0
     return jax.random.fold_in(key, rank)
 

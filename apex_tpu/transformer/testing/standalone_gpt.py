@@ -308,6 +308,12 @@ class ParallelTransformerLayer:
                          params["input_layernorm"]["bias"], eps=eps)
         attn = self.attention.apply(params["attention"], ln1, attention_mask,
                                     dropout_key=k_attn)
+        # named for remat_policy="attn_out": saving just this [b,s,h]
+        # tensor per layer (16 MB at the 350M bench shape) removes the
+        # whole attention region from the remat recompute
+        from jax.ad_checkpoint import checkpoint_name
+
+        attn = checkpoint_name(attn, "attn_out")
         h = h + _hidden_dropout(attn, cfg, k_h1)
         ln2 = layer_norm(h, params["post_attention_layernorm"]["weight"],
                          params["post_attention_layernorm"]["bias"], eps=eps)
@@ -367,8 +373,17 @@ class ParallelTransformer:
             # same inputs), the property the reference's CheckpointFunction
             # restores CUDA RNG state for.  remat_policy="dots" keeps the
             # memory ceiling but skips recomputing the matmuls (the flops).
-            policy = (jax.checkpoint_policies.dots_saveable
-                      if self.cfg.remat_policy == "dots" else None)
+            if self.cfg.remat_policy == "dots":
+                policy = jax.checkpoint_policies.dots_saveable
+            elif self.cfg.remat_policy == "attn_out":
+                # keep the flash-attention output per layer (named above):
+                # +16 MB/layer at the 350M shape, and the recompute no
+                # longer re-runs the attention kernel — measured ~7% off
+                # the step at B=8 (BASELINE.md r4 remat sweep)
+                policy = jax.checkpoint_policies.save_only_these_names(
+                    "attn_out")
+            else:
+                policy = None
             body = jax.checkpoint(body, policy=policy)
         (h, aux), _ = jax.lax.scan(
             body, (h, jnp.zeros((), jnp.float32)),
@@ -424,13 +439,20 @@ class GPTModel:
         pos = params["position_embeddings"]["weight"][:tokens.shape[1]]
         return (h + pos[None]).astype(self.cfg.compute_dtype)
 
+    def _final_norm(self, params, h):
+        return layer_norm(h, params["final_layernorm"]["weight"],
+                          params["final_layernorm"]["bias"],
+                          eps=self.cfg.layernorm_epsilon)
+
     def head_logits_local(self, params, h):
         """Sharded logits [b, s, vocab/tp] through the tied embedding
         (reference post_language_model_processing / parallel_lm_logits)."""
-        h = layer_norm(h, params["final_layernorm"]["weight"],
-                       params["final_layernorm"]["bias"],
-                       eps=self.cfg.layernorm_epsilon)
-        w = params["embedding"]["weight"]  # [vocab/tp, hidden]
+        h = self._final_norm(params, h)
+        # cast the tied fp32 master weight to the compute dtype (O2
+        # semantics, and what the fused tp=1 head does): a mixed
+        # bf16xfp32 dot would silently promote to an fp32 matmul
+        w = params["embedding"]["weight"].astype(
+            self.cfg.compute_dtype)  # [vocab/tp, hidden]
         return jax.lax.dot_general(
             h, w, (((h.ndim - 1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -448,10 +470,22 @@ class GPTModel:
         h, aux = self.transformer.apply(params["transformer"], h,
                                         attention_mask,
                                         dropout_key=dropout_key)
-        logits_local = self.head_logits_local(params, h)
         if labels is None:
-            return logits_local
-        losses = vocab_parallel_cross_entropy(logits_local, labels)
+            return self.head_logits_local(params, h)
+        if self.cfg.tp_size == 1:
+            # single-shard head: fuse projection + CE so only bf16 logits
+            # + fp32 lse round-trip HBM (ops/fused_linear_xent.py; the
+            # TP-sharded head keeps the collective vocab-parallel CE)
+            from apex_tpu.ops import fused_linear_cross_entropy
+
+            hn = self._final_norm(params, h)
+            b, s, hid = hn.shape
+            losses = fused_linear_cross_entropy(
+                hn.reshape(b * s, hid), params["embedding"]["weight"],
+                labels.reshape(b * s)).reshape(b, s)
+        else:
+            logits_local = self.head_logits_local(params, h)
+            losses = vocab_parallel_cross_entropy(logits_local, labels)
         if self.cfg.num_experts > 0:
             # fold the MoE load-balancing term in per-token so that
             # mean(losses) == CE_mean + coeff * aux (the Megatron
@@ -486,6 +520,15 @@ def make_gpt_stage_fns(cfg: GPTConfig, n_stages: int
     """
     if cfg.num_layers % n_stages != 0:
         raise ValueError("num_layers must divide evenly into stages")
+    if getattr(cfg, "num_experts", 0):
+        import warnings
+
+        warnings.warn(
+            "MoE under pipeline parallelism drops the load-balancing aux "
+            "loss (stage outputs are a single hidden tensor) — routing "
+            "can silently collapse. Use MoE with TP/DP, or thread a "
+            "custom stage contract that carries the aux loss.",
+            stacklevel=2)
     model = GPTModel(cfg, num_layers=cfg.num_layers // n_stages)
 
     def stage_fn(params, h_in, mb):
