@@ -30,9 +30,11 @@ import tempfile
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
+import numpy as np
 
 __all__ = ["OpTime", "parse_trace_dir", "top_ops_report",
-           "format_top_ops"]
+           "format_top_ops", "device_time_ms", "hlo_fusion_flops",
+           "join_roofline"]
 
 
 @dataclasses.dataclass
@@ -175,6 +177,150 @@ def top_ops_report(fn: Callable, *args, steps: int = 3,
             import shutil
 
             shutil.rmtree(logdir, ignore_errors=True)
+
+
+def device_time_ms(fn: Callable, *args, steps: int = 4,
+                   exclude: Sequence[str] = ("copy",), **kwargs) -> float:
+    """Total *device* milliseconds per invocation of ``fn`` — the sum of
+    per-call leaf-op times from a profiler trace.  Immune to host-side
+    dispatch noise (the relay's multi-ms variable floor that poisoned the
+    r3 record): device timestamps come from the chip.  ``fn`` must be
+    jitted and warmed.  Ops whose name starts with any ``exclude`` prefix
+    (default: donation copies) are dropped.  Each op's TOTAL time is
+    divided by the number of invocations (``steps``), NOT by its call
+    count — an op inside a ``lax.scan``/remat body executes many times
+    per invocation, and dividing by calls would count one body iteration
+    instead of all of them.  Raises if the trace is empty, so callers
+    can fall back to wall-clock timing."""
+    ops = top_ops_report(fn, *args, steps=steps, top=256, **kwargs)
+    tot = sum(o.total_ms for o in ops
+              if not o.name.startswith(tuple(exclude))) / steps
+    if tot <= 0:
+        raise RuntimeError("profiler trace contained no device ops")
+    return tot
+
+
+_CALLER_RE = re.compile(
+    r"%([\w.-]+) = [^\n]*?(?:calls|to_apply|body)=%([\w.-]+)", re.M)
+_COMP_DEF_RE = re.compile(
+    r"^(?:ENTRY )?%?([\w.-]+) \([^)]*\) -> .+ \{", re.M)
+
+
+def _body_flops(body: str) -> float:
+    """Matmul/conv flops inside one HLO computation body.
+
+    Estimator: ``2 * sqrt(|A| * |B| * |O|)`` over the element counts of
+    the two operands and the output — EXACT for any contraction where
+    each logical dim appears in exactly two of the three tensors (plain
+    and transposed matmuls, and XLA's conv-formulated weight-gradients),
+    approximate for batched dots (over by sqrt(batch)) and spatial convs
+    (under by sqrt(window)).  The same class of shape-heuristic as
+    pyprof's prof/blas.py; adequate for ranking ops by
+    distance-from-roof."""
+    # first pass: instruction name -> element count (operand shapes live
+    # on their DEFINING lines, not on the consuming dot/conv line)
+    sizes: Dict[str, float] = {}
+    def_re = re.compile(r"^\s*(?:ROOT )?%([\w.-]+) = \w+\[([\d,]*)\]")
+    for line in body.splitlines():
+        m = def_re.match(line)
+        if m:
+            shape = m.group(2)
+            sizes[m.group(1)] = float(np.prod(
+                [int(x) for x in shape.split(",") if x])) if shape else 1.0
+    flops = 0.0
+    for line in body.splitlines():
+        m = def_re.match(line)
+        if m is None:
+            continue
+        if not (" dot(" in line or "dot-general" in line
+                or " convolution(" in line):
+            continue
+        out_sz = sizes[m.group(1)]
+        call = line[line.index("(", line.index("= ")):]
+        operands = re.findall(r"%([\w.-]+)", call.split("),")[0])
+        ops_sz = [sizes.get(o) for o in operands[:2]]
+        if len(ops_sz) < 2 or None in ops_sz:
+            continue
+        flops += 2.0 * float(np.sqrt(out_sz * ops_sz[0] * ops_sz[1]))
+    return flops
+
+
+def hlo_fusion_flops(hlo_text: str) -> Dict[str, tuple]:
+    """instruction/computation name -> (estimated matmul/conv flops,
+    op_name metadata), parsed from compiled HLO text
+    (``lowered.compile().as_text()``).  The op_name carries the
+    jax-level trace path (module/op/source), turning anonymous
+    ``fusion.NN`` trace rows into attributable ops — the identity the
+    reference pyprof recovers from NVTX ranges.
+
+    Flops are counted RECURSIVELY through called computations, so
+    checkpoint/remat/call spans (the dominant rows of a remat'd step's
+    profile) get their contained matmul flops too, not just leaf
+    fusions.  A ``while`` body's flops are counted once (the static
+    trip count is not recoverable from HLO text) — an undercount for
+    loops, stated here rather than hidden."""
+    names = [m for m in _COMP_DEF_RE.finditer(hlo_text)]
+    bodies: Dict[str, str] = {}
+    for i, m in enumerate(names):
+        end = names[i + 1].start() if i + 1 < len(names) else len(hlo_text)
+        bodies[m.group(1)] = hlo_text[m.start():end]
+
+    memo: Dict[str, float] = {}
+
+    def comp_flops(comp: str, stack=()) -> float:
+        if comp in memo:
+            return memo[comp]
+        if comp in stack:  # defensive: HLO call graphs are acyclic
+            return 0.0
+        body = bodies.get(comp)
+        if body is None:
+            return 0.0
+        total = _body_flops(body)
+        for m in _CALLER_RE.finditer(body):
+            total += comp_flops(m.group(2), stack + (comp,))
+        memo[comp] = total
+        return total
+
+    out: Dict[str, tuple] = {}
+    for m in _CALLER_RE.finditer(hlo_text):
+        inst, comp = m.group(1), m.group(2)
+        line = hlo_text[m.start():hlo_text.find("\n", m.start())]
+        nm = re.search(r'op_name="([^"]*)"', line)
+        out.setdefault(inst, (comp_flops(comp), nm.group(1) if nm else ""))
+    for comp in bodies:  # trace rows sometimes carry the COMPUTATION name
+        out.setdefault(comp, (comp_flops(comp), ""))
+    # every remaining instruction still gets its op_name label — custom
+    # calls (Pallas kernels) are opaque to flops parsing (est 0, like
+    # XLA's own cost analysis) but their source identity matters most:
+    # they ARE the handwritten kernels being judged
+    for m in re.finditer(
+            r"^\s*(?:ROOT )?%([\w.-]+) = [^\n]*?"
+            r'op_name="([^"]*)"', hlo_text, re.M):
+        out.setdefault(m.group(1), (0.0, m.group(2)))
+    return out
+
+
+def join_roofline(ops: Sequence[OpTime], hlo_text: str,
+                  roof_tflops: Optional[float] = None) -> List[dict]:
+    """pyprof prof/output.py parity (measured time JOINED with derived
+    flops): each measured op gains estimated flops, achieved TFLOPS, and
+    fraction-of-roof.  Ops with no matmul/conv content get flops 0."""
+    fl = hlo_fusion_flops(hlo_text)
+    rows = []
+    for o in ops:
+        f, op_name = fl.get(o.name, (0.0, ""))
+        t = o.total_ms / max(o.calls, 1) / 1e3
+        tf = f / t / 1e12 if t > 0 else 0.0
+        row = {"name": o.name, "ms": round(o.total_ms / max(o.calls, 1), 3),
+               "calls": o.calls, "frac_of_device": round(o.frac_of_device, 3),
+               "est_gflops": round(f / 1e9, 2), "achieved_tflops": round(tf, 1)}
+        if op_name:
+            # keep the informative tail (op + source), not the jit prefix
+            row["op"] = op_name[-80:]
+        if roof_tflops:
+            row["frac_of_roof"] = round(tf / roof_tflops, 3)
+        rows.append(row)
+    return rows
 
 
 def format_top_ops(ops: Sequence[OpTime], *, top: int = 10) -> str:

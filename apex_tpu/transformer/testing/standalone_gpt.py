@@ -472,10 +472,12 @@ class GPTModel:
                                         dropout_key=dropout_key)
         if labels is None:
             return self.head_logits_local(params, h)
-        if self.cfg.tp_size == 1:
-            # single-shard head: fuse projection + CE so only bf16 logits
-            # + fp32 lse round-trip HBM (ops/fused_linear_xent.py; the
-            # TP-sharded head keeps the collective vocab-parallel CE)
+        if self.cfg.tp_size == 1 and self.cfg.compute_dtype != jnp.float32:
+            # single-shard half-precision head: fuse projection + CE so
+            # only bf16 logits + fp32 lse round-trip HBM
+            # (ops/fused_linear_xent.py).  fp32 configs keep the full-
+            # precision unfused head (the fused op narrows operands);
+            # TP-sharded heads keep the collective vocab-parallel CE.
             from apex_tpu.ops import fused_linear_cross_entropy
 
             hn = self._final_norm(params, h)

@@ -6,25 +6,33 @@ master weights, dynamic loss scale), FusedLAMB, synthetic ImageNet batch —
 the throughput the reference's examples/imagenet/main_amp.py prints per
 iteration (:361-376).
 
-Measurement methodology (reworked in r3 after the r2 numbers proved
-artifacts — VERDICT r2 weak #3/#4 + items 4/9):
+Measurement methodology (bench_schema 2, reworked in r4 — VERDICT r3
+items 2/4 — after the r3 record was shown to carry host-clock artifacts):
 
-* The relay platform adds a large, *variable* per-dispatch and
-  per-scan-iteration overhead (measured ~2-3 ms floor, with whole-process
-  slow phases 5-10× worse).  Microbenches therefore time by **slope**:
-  run a scan whose body applies the op K_lo and K_hi times and divide the
-  time difference by (K_hi-K_lo)·n — fixed costs cancel exactly.
-* The matmul roof uses 8192³ (big enough that compute dwarfs any floor)
-  and takes the best of several trials: the demonstrated capability of
-  the chip, not the average of its contention states.
+* Kernel microbenches and the roofs time on **device clocks** (profiler
+  traces, ``_device_ms``): the relay's variable multi-ms dispatch floor
+  poisoned host wall-clock at sub-ms scale in BOTH directions (r3
+  recorded the LN backward at 0.17x and fused softmax at 12.4x; device
+  timestamps measure 1.08x and 1.0x for the same builds).  The
+  slope-of-mins host timing survives only as the fallback when a
+  profiler capture fails, and each record entry carries a ``timing``
+  field saying which ran.
+* Whole-model workloads (ResNet/GPT, hundreds of ms per step) still use
+  best-of-N host wall-clock — there the relay floor is percent-level —
+  with a value fetch as the sync (the relay's block_until_ready returns
+  early).
 * MFU is computed from **analytic model flops** (6·N per token for GPT,
   ~3× single-pass conv flops for RN50 fwd+bwd), NOT from XLA cost
   analysis: cost analysis can't see inside Pallas custom calls
   (undercounts) and counts remat recompute (overcounts the model).  Both
   numbers are still reported side by side in extras.
-* Every Pallas kernel must beat its XLA formulation at a
-  bandwidth-honest working-set size to keep its default ("win or fall
-  back") — the per-kernel microbenches below are the enforcement record.
+* Every Pallas kernel must beat (or tie) its XLA formulation to keep its
+  default — enforced in code: ops/kernel_defaults.py lists the gates and
+  tests/L0/test_kernel_defaults.py fails CI on a losing default in the
+  newest committed record.
+* Per-op attribution (``*_top_ops``) is captured in SUBPROCESSES,
+  default ON, with measured time joined to HLO-derived flops
+  (profiling.trace_report.join_roofline) — the pyprof prof-stage table.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extras"}.
 ``vs_baseline`` compares against BASELINE.json["measured"].
@@ -130,16 +138,49 @@ def _time_slope_group(cases, *, lo=1, hi=5, n=6, trials=5):
     return out
 
 
+def _device_ms(fn, *args, steps=4):
+    """Per-invocation DEVICE milliseconds via a profiler trace (see
+    profiling.trace_report.device_time_ms).  The r3 record proved host
+    wall-clock unusable for sub-ms kernels on the relay (its variable
+    multi-ms dispatch floor recorded a 0.17x "regression" for a kernel
+    that wins 1.08x on device timestamps), so every kernel microbench
+    now times on device and falls back to the host slope only when the
+    profiler capture fails."""
+    from apex_tpu.profiling.trace_report import device_time_ms
+
+    jitted = jax.jit(fn)
+    _fetch(jitted(*args))
+    return device_time_ms(jitted, *args, steps=steps)
+
+
+def _timed_pair(fn_a, fn_b, args_a, args_b, slope_cases):
+    """(seconds_a, seconds_b, how): device-trace first, host-slope
+    fallback — both candidates always measured the same way."""
+    try:
+        return (_device_ms(fn_a, *args_a) / 1e3,
+                _device_ms(fn_b, *args_b) / 1e3, "device-trace")
+    except Exception:
+        t = _time_slope_group(slope_cases)
+        return t[0], t[1], "host-slope"
+
+
 def bench_matmul_roof():
     """Demonstrated bf16 matmul ceiling (TFLOPS) — the MFU denominator.
 
-    8192³ so compute (~1.1 TFLOP/iter) dwarfs the relay floor; best of
-    trials because the relay has whole-process slow phases."""
+    8192³, DEVICE-timed (a host-timed roof inherits the relay's slow
+    phases and once recorded 136 TF for a 190 TF chip, inflating every
+    MFU fraction divided by it); host slope fallback."""
     m = 8192
     a = jax.random.normal(jax.random.PRNGKey(0), (m, m), jnp.bfloat16)
     b = jax.random.normal(jax.random.PRNGKey(1), (m, m), jnp.bfloat16)
-    t = _time_slope(lambda x, b: (x @ b).astype(jnp.bfloat16), a, b,
-                    lo=1, hi=3, n=8, trials=3)
+
+    def mm(x, b):
+        return (x @ b).astype(jnp.bfloat16)
+
+    try:
+        t = _device_ms(mm, a, b, steps=6) / 1e3
+    except Exception:
+        t = _time_slope(mm, a, b, lo=1, hi=3, n=8, trials=3)
     return 2 * m ** 3 / t / 1e12
 
 
@@ -172,7 +213,10 @@ def bench_hbm_roof():
             interpret=jax.default_backend() != "tpu",
         )(v)
 
-    t = _time_slope(hbm_copy, x, lo=1, hi=5, n=4, trials=3)
+    try:
+        t = _device_ms(hbm_copy, x, steps=6) / 1e3
+    except Exception:
+        t = _time_slope(hbm_copy, x, lo=1, hi=5, n=4, trials=3)
     return 2 * x.size * 4 / t / 1e9  # read + write
 
 
@@ -185,8 +229,10 @@ def bench_hbm_roof():
 RN50_ANALYTIC_FLOPS_PER_IMG = 3 * 4.09e9
 
 
-def bench_resnet():
-    """Returns (images/sec, analytic TFLOPS, cost-analysis TFLOPS, loss)."""
+def _resnet_setup():
+    """One construction of the ResNet bench workload (amp O2 + FusedLAMB
+    + dynamic scale), shared by the throughput bench and the top-ops
+    child."""
     model = ResNet(resnet50_config())
     params, bn_state = model.init(jax.random.PRNGKey(0))
 
@@ -216,6 +262,13 @@ def bench_resnet():
     x = jax.random.normal(jax.random.PRNGKey(1), (BATCH, IMG, IMG, 3),
                           jnp.bfloat16)
     y = jax.random.randint(jax.random.PRNGKey(2), (BATCH,), 0, 1000)
+    return train_step, params, bn_state, opt_state, scale_state, x, y
+
+
+def bench_resnet():
+    """Returns (images/sec, analytic TFLOPS, cost-analysis TFLOPS, loss)."""
+    (train_step, params, bn_state, opt_state, scale_state,
+     x, y) = _resnet_setup()
 
     # warm the jit fastpath first, then read flops from an explicit
     # lower+compile (the persistent compile cache dedupes it)
@@ -260,12 +313,11 @@ def gpt_analytic_flops(n_tokens, batch, *, with_remat=False):
     return total
 
 
-def bench_gpt350m():
-    """Megatron GPT-2 350M-class (hidden 1024, 24 layers, 16 heads, seq
-    1024) single-chip training throughput.
-
-    Returns (tokens/sec, analytic model TFLOPS, analytic hw TFLOPS,
-    cost-analysis TFLOPS, remat_policy, top_ops)."""
+def _gpt_setup():
+    """One construction of the GPT bench workload (model, donated-jit
+    train step, data) shared by the throughput bench AND the top-ops
+    child — so the profiled program IS the benched program (same
+    donation, same remat policy)."""
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -284,8 +336,7 @@ def bench_gpt350m():
     mesh = parallel_state.initialize_model_parallel(
         1, 1, devices=jax.devices()[:1])
     model = GPTModel(cfg)
-    master = model.init_master(jax.random.PRNGKey(0))
-    params = model.shard_master(master, 0)
+    params = model.shard_master(model.init_master(jax.random.PRNGKey(0)), 0)
     opt = optimizers.FusedAdam(lr=1e-4)
     opt_state = opt.init(params)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (B, GPT_SEQ), 0,
@@ -307,6 +358,19 @@ def bench_gpt350m():
         p, opt_state = opt.step(grads, opt_state, p)
         return p, opt_state, loss
 
+    return train_step, params, opt_state, tokens, labels, remat_policy, B
+
+
+def bench_gpt350m():
+    """Megatron GPT-2 350M-class (hidden 1024, 24 layers, 16 heads, seq
+    1024) single-chip training throughput.
+
+    Returns (tokens/sec, analytic model TFLOPS, analytic hw TFLOPS,
+    cost-analysis TFLOPS, remat_policy, top_ops)."""
+    from apex_tpu.transformer import parallel_state
+
+    (train_step, params, opt_state, tokens, labels, remat_policy,
+     B) = _gpt_setup()
     steps = 6
     params, opt_state, loss = train_step(params, opt_state, tokens, labels)
     float(loss)
@@ -320,31 +384,8 @@ def bench_gpt350m():
                                                  labels)
         final = float(loss)
         best_dt = min(best_dt, (time.perf_counter() - t0) / steps)
-    # pyprof-prof-stage parity: top ops of the step by MEASURED device
-    # time (profiling.top_ops_report) — the table that names the real
-    # time sinks, recorded for the tuning log in BASELINE.md.  Opt-in
-    # (BENCH_TOP_OPS=1): on the relay backend a failed profiler capture
-    # can poison the process with RESOURCE_EXHAUSTED for every
-    # subsequent dispatch, losing the rest of the record.
-    top_ops = []
-    if os.environ.get("BENCH_TOP_OPS", "0") == "1":
-        try:
-            # rebind through a closure: train_step donates its first two
-            # args, so repeated calls must chain the fresh outputs
-            state = {"p": params, "o": opt_state}
-
-            def prof_step(t, l):
-                state["p"], state["o"], loss = train_step(
-                    state["p"], state["o"], t, l)
-                return loss
-
-            ops = profiling.top_ops_report(prof_step, tokens, labels,
-                                           steps=2, top=3)
-            top_ops = [{"name": o.name[:80], "ms": round(o.total_ms, 2),
-                        "frac": round(o.frac_of_device, 3)} for o in ops]
-            params, opt_state = state["p"], state["o"]
-        except Exception as e:
-            top_ops = [{"error": repr(e)[:120]}]
+    # top-ops capture lives in a SUBPROCESS (main() calls
+    # _topops_subprocess) so a poisoned capture cannot lose the record
     parallel_state.destroy_model_parallel()
     assert jnp.isfinite(final), f"gpt diverged: {final}"
     n_tok = B * GPT_SEQ
@@ -353,7 +394,7 @@ def bench_gpt350m():
                                with_remat=(remat_policy == "full"))
     return (n_tok / best_dt, model_fl / best_dt / 1e12,
             hw_fl / best_dt / 1e12, cost_flops / best_dt / 1e12,
-            remat_policy, top_ops)
+            remat_policy, None)
 
 
 # ---------------------------------------------------------------------------
@@ -361,9 +402,12 @@ def bench_gpt350m():
 # ---------------------------------------------------------------------------
 
 
-def bench_attention_kernel(bh, s, d, block_q, block_k):
-    """Pallas flash attention, fwd and fwd+bwd (causal, bf16): TFLOPS,
-    plus the XLA-naive fwd for reference."""
+def bench_attention_kernel(bh, s, d, block_q, block_k, measure_floor=False):
+    """Pallas flash attention, fwd and fwd+bwd (causal, bf16): TFLOPS on
+    DEVICE time, plus the XLA-naive fwd and (optionally) the pure-MXU
+    dot floor at this shape — the demonstrated ceiling for any attention
+    at this head dim (d=64 halves the MXU lane utilisation; measured
+    46.9 TF vs 96.6 TF for d=128 at equal flops on v5e)."""
     from apex_tpu.ops.attention import flash_attention
 
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
@@ -391,59 +435,120 @@ def bench_attention_kernel(bh, s, d, block_q, block_k):
         g = jax.grad(loss, argnums=(0, 1, 2))(x, k, v)
         return x + g[0].astype(x.dtype) * 1e-6
 
-    # fwd and its naive rival interleave (phase-fair); bwd separate
+    out = {}
     naive_err = None
     try:
-        t_f, t_n = _time_slope_group(
-            [(fwd, q, (k, v)), (naive, q, (k, v))], lo=1, hi=3, n=4)
+        t_f, t_n, how = _timed_pair(
+            fwd, naive, (q, k, v), (q, k, v),
+            [(fwd, q, (k, v)), (naive, q, (k, v))])
     except Exception as e:
-        # do NOT label this a structural naive-OOM win: transient relay
-        # failures land here too — record what actually happened and
-        # measure the kernel alone
         naive_err = repr(e)[:120]
         t_f = _time_slope(fwd, q, k, v, lo=1, hi=4, n=5)
-    t_fb = _time_slope(train, q, k, v, lo=1, hi=3, n=4)
-    out = {
-        "fwd_tflops": round(fwd_flops / t_f / 1e12, 1),
-        "fwdbwd_tflops": round((fwd_flops + bwd_flops) / t_fb / 1e12, 1),
-    }
+        how = "host-slope"
+    try:
+        t_fb = _device_ms(train, q, k, v) / 1e3
+    except Exception:
+        t_fb = _time_slope(train, q, k, v, lo=1, hi=3, n=4)
+    out["fwd_tflops"] = round(fwd_flops / t_f / 1e12, 1)
+    out["fwdbwd_tflops"] = round((fwd_flops + bwd_flops) / t_fb / 1e12, 1)
+    out["timing"] = how
     if naive_err is None:
         out["xla_naive_fwd_tflops"] = round(fwd_flops / t_n / 1e12, 1)
         out["fwd_speedup_vs_naive"] = round(t_n / t_f, 2)
     else:
         out["xla_naive_error"] = naive_err
+    if measure_floor:
+        out["dot_floor_tflops"] = round(
+            _attention_dot_floor(bh, s, d, block_q, block_k), 1)
     return out
 
 
+def _attention_dot_floor(bh, s, d, block_q, block_k):
+    """TFLOPS of a kernel doing ONLY the two attention matmuls (no
+    softmax, same tiling, causal trip skip) — the MXU ceiling the fwd
+    kernel is measured against.  The bwd ceiling is 2.5x this work."""
+    from jax.experimental import pallas as pl
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (bh, s, d), jnp.bfloat16) for kk in ks)
+    bq, bk = min(block_q, s), min(block_k, s)
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        qi = pl.program_id(1) * bq
+        qq = q_ref[0]
+        n_kb = jnp.minimum(s // bk, (qi + bq - 1) // bk + 1)
+
+        def body(kb, acc):
+            kk = k_ref[0, pl.ds(kb * bk, bk), :]
+            vv = v_ref[0, pl.ds(kb * bk, bk), :]
+            sc = jax.lax.dot_general(qq, kk, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            return acc + jax.lax.dot_general(
+                (sc * 1e-3).astype(vv.dtype), vv, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        acc = jax.lax.fori_loop(0, n_kb, body,
+                                jnp.zeros((bq, d), jnp.float32))
+        o_ref[0] = acc.astype(o_ref.dtype)
+
+    def run(q, k, v):
+        return pl.pallas_call(
+            kernel,
+            grid=(bh, s // bq),
+            in_specs=[
+                pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        )(q, k, v)
+
+    flops = 4 * bh * s * s * d / 2
+    try:
+        t = _device_ms(run, q, k, v) / 1e3
+    except Exception:
+        t = _time_slope(run, q, k, v, lo=1, hi=3, n=4)
+    return flops / t / 1e12
+
+
 def bench_layernorm_kernel():
-    """Fused LN fwd and bwd, Pallas vs XLA, at a bandwidth-honest working
-    set (bf16 rows, 256 MB+ traffic per application): GB/s each.  The
-    winner keeps the TPU default — enforced in ops/fused_layer_norm.py."""
+    """Fused LN fwd and bwd, Pallas/custom_vjp vs XLA-AD-of-naive, at a
+    bandwidth-honest working set, DEVICE-timed with a RANDOM cotangent
+    (a ones cotangent lets XLA fold the AD rival's backward — the r3
+    record's 0.17x was that artifact plus host-clock noise; on device
+    time the fused backward wins 1.08x).  A handwritten Pallas backward
+    was built and measured slower than the XLA custom_vjp formulation
+    (1.84 vs 1.38 ms — BASELINE.md r4 LN notes), so XLA-inside-
+    custom_vjp IS the winning fused backward on TPU."""
     from apex_tpu.ops.fused_layer_norm import (
         _pallas_ln_fwd, _xla_ln_fwd, layer_norm)
 
     rows, cols = 16384, 4096
     x = jax.random.normal(jax.random.PRNGKey(0), (rows, cols), jnp.bfloat16)
+    r = jax.random.normal(jax.random.PRNGKey(7), (rows, cols), jnp.bfloat16)
     w = jnp.ones((cols,), jnp.float32)
     b = jnp.zeros((cols,), jnp.float32)
     nbytes = rows * cols * 2
 
-    t_p, t_x = _time_slope_group([
-        (lambda v, w, b: _pallas_ln_fwd(v, w, b, 1e-5)[0], x, (w, b)),
-        (lambda v, w, b: _xla_ln_fwd(v, w, b, 1e-5)[0], x, (w, b)),
-    ])
+    fwd_p = lambda v, w, b: _pallas_ln_fwd(v, w, b, 1e-5)[0]
+    fwd_x = lambda v, w, b: _xla_ln_fwd(v, w, b, 1e-5)[0]
+    t_p, t_x, how = _timed_pair(
+        fwd_p, fwd_x, (x, w, b), (x, w, b),
+        [(fwd_p, x, (w, b)), (fwd_x, x, (w, b))])
     out = {
         "fwd_pallas_gb_s": round(2 * nbytes / t_p / 1e9, 1),
         "fwd_xla_gb_s": round(2 * nbytes / t_x / 1e9, 1),
         "fwd_speedup": round(t_x / t_p, 2),
+        "timing": how,
     }
 
-    # backward: the fused dgrad+dgamma+dbeta custom_vjp vs jax AD of the
-    # naive formulation (what users get without the fused op)
-    def fused_bwd(v, w, b):
-        g = jax.grad(lambda xx: jnp.sum(
-            layer_norm(xx, w, b).astype(jnp.float32)))(v)
-        return g
+    # backward: the fused custom_vjp vs jax AD of the naive formulation
+    # (what users get without the fused op), real cotangent r
+    def fused_bwd(v, w, b, r):
+        return jax.grad(lambda xx: jnp.sum(
+            layer_norm(xx, w, b).astype(jnp.float32)
+            * r.astype(jnp.float32)))(v)
 
     def naive_ln(xx, w, b):
         xf = xx.astype(jnp.float32)
@@ -452,22 +557,24 @@ def bench_layernorm_kernel():
         return (((xf - mu) * jax.lax.rsqrt(var + 1e-5)) * w + b).astype(
             xx.dtype)
 
-    def ad_bwd(v, w, b):
+    def ad_bwd(v, w, b, r):
         return jax.grad(lambda xx: jnp.sum(
-            naive_ln(xx, w, b).astype(jnp.float32)))(v)
+            naive_ln(xx, w, b).astype(jnp.float32)
+            * r.astype(jnp.float32)))(v)
 
-    t_fb, t_ab = _time_slope_group(
-        [(fused_bwd, x, (w, b)), (ad_bwd, x, (w, b))], lo=1, hi=3, n=4)
-    # fwd+bwd traffic ~ 4 passes over x (fwd read/write + bwd read x,g
-    # write dx)
+    t_fb, t_ab, how_b = _timed_pair(
+        fused_bwd, ad_bwd, (x, w, b, r), (x, w, b, r),
+        [(fused_bwd, x, (w, b, r)), (ad_bwd, x, (w, b, r))])
     out["bwd_fused_gb_s"] = round(4 * nbytes / t_fb / 1e9, 1)
     out["bwd_ad_gb_s"] = round(4 * nbytes / t_ab / 1e9, 1)
     out["bwd_speedup"] = round(t_ab / t_fb, 2)
+    out["bwd_timing"] = how_b
     return out
 
 
 def bench_softmax_kernel():
-    """Fused causal (upper-triang) scale-mask-softmax vs naive XLA."""
+    """Fused causal (upper-triang) scale-mask-softmax vs naive XLA,
+    device-timed."""
     from apex_tpu.ops import AttnMaskType, FusedScaleMaskSoftmax
 
     b, h, s = 8, 16, 1024
@@ -477,24 +584,31 @@ def bench_softmax_kernel():
         attn_mask_type=AttnMaskType.causal,
         scaled_masked_softmax_fusion=True, softmax_in_fp32=True, scale=1.0)
 
+    def fused_fn(v):
+        return fused(v, None)
+
     def naive(v):
         m = jnp.tril(jnp.ones((s, s), bool))
         sc = jnp.where(m, v.astype(jnp.float32), -1e30)
         return jax.nn.softmax(sc, -1).astype(v.dtype)
 
-    t_f, t_n = _time_slope_group(
-        [(lambda v: fused(v, None), x, ()), (naive, x, ())],
-        lo=1, hi=3, n=4)  # tril mask is tiny, safe to close over
+    t_f, t_n, how = _timed_pair(fused_fn, naive, (x,), (x,),
+                                [(fused_fn, x, ()), (naive, x, ())])
     nbytes = x.size * 2  # read + write bf16, intermediates stay fused
     return {
         "fused_gb_s": round(2 * nbytes / t_f / 1e9, 1),
         "xla_naive_gb_s": round(2 * nbytes / t_n / 1e9, 1),
         "speedup": round(t_n / t_f, 2),
+        "timing": how,
     }
 
 
 def bench_xentropy_kernel():
-    """Fused vocab cross entropy (fwd+bwd) vs naive XLA formulation."""
+    """Fused vocab cross entropy (fwd+bwd) vs naive XLA formulation,
+    device-timed.  Both run at the HBM roof at this shape (the op is
+    bandwidth-bound and XLA fuses the naive form equally well — the r3
+    0.59x was host-clock noise); the fused op's value is the saved-lse
+    contract, not a speedup, and the gate only requires it not losing."""
     n, v = 8192, 51200
     logits = jax.random.normal(jax.random.PRNGKey(0), (n, v),
                                jnp.float32) * 2
@@ -513,15 +627,136 @@ def bench_xentropy_kernel():
             return jnp.mean(nll)
         return x - jax.grad(f)(x)
 
-    t_f, t_n = _time_slope_group(
-        [(fused_step, logits, (labels,)), (naive_step, logits, (labels,))],
-        lo=1, hi=3, n=3)
-    # relative only, same rationale as bench_softmax_kernel
+    t_f, t_n, how = _timed_pair(
+        fused_step, naive_step, (logits, labels), (logits, labels),
+        [(fused_step, logits, (labels,)), (naive_step, logits, (labels,))])
     return {
         "fused_us": round(t_f * 1e6, 1),
         "xla_naive_us": round(t_n * 1e6, 1),
         "speedup": round(t_n / t_f, 2),
+        "timing": how,
     }
+
+
+def bench_fused_linear_xent():
+    """The r4 fused linear+CE op vs AD of the plain formulation at the
+    GPT head shape — the region-level fusion the reference xentropy
+    existed for (VERDICT r3 item 6)."""
+    from apex_tpu.ops import fused_linear_cross_entropy
+
+    N, H, V = 8192, 1024, 51200
+    h = jax.random.normal(jax.random.PRNGKey(0), (N, H), jnp.bfloat16) * .02
+    w = jax.random.normal(jax.random.PRNGKey(1), (V, H), jnp.bfloat16) * .02
+    labels = jax.random.randint(jax.random.PRNGKey(2), (N,), 0, V)
+    flops = 3 * 2 * N * H * V
+
+    def fused(h, w, labels):
+        loss, (dh, dw) = jax.value_and_grad(
+            lambda h, w: jnp.mean(fused_linear_cross_entropy(h, w, labels)),
+            argnums=(0, 1))(h, w)
+        return dh.astype(jnp.float32).sum() + dw.astype(
+            jnp.float32).sum() + loss
+
+    def plain(h, w, labels):
+        def lossf(h, w):
+            z = jax.lax.dot_general(h, w, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            m = jnp.max(z, axis=-1)
+            lse = m + jnp.log(jnp.sum(jnp.exp(z - m[:, None]), axis=-1))
+            tz = jnp.take_along_axis(z, labels[:, None], axis=-1)[:, 0]
+            return jnp.mean(lse - tz)
+        loss, (dh, dw) = jax.value_and_grad(lossf, argnums=(0, 1))(h, w)
+        return dh.astype(jnp.float32).sum() + dw.astype(
+            jnp.float32).sum() + loss
+
+    t_f, t_p, how = _timed_pair(
+        fused, plain, (h, w, labels), (h, w, labels),
+        [(fused, h, (w, labels)), (plain, h, (w, labels))])
+    return {
+        "fused_tflops": round(flops / t_f / 1e12, 1),
+        "plain_ad_tflops": round(flops / t_p / 1e12, 1),
+        "speedup": round(t_p / t_f, 2),
+        "timing": how,
+    }
+
+
+def _topops_child(which):
+    """Child-process entry (BENCH_TOPOPS_CHILD=gpt|resnet): build the
+    workload, run 2 steps under the profiler, print ONE line
+    `TOPOPS_JSON:<json>` and exit.  Runs in a SUBPROCESS so a failed
+    capture (the relay has poisoned whole processes with
+    RESOURCE_EXHAUSTED after a bad capture) cannot take down the bench
+    record (VERDICT r3 item 4) — and the capture is now default-ON."""
+    import sys
+
+    from apex_tpu.profiling.trace_report import (
+        join_roofline, top_ops_report)
+
+    if which == "gpt":
+        step, a, b, hlo = _build_gpt_step()
+    else:
+        step, a, b, hlo = _build_resnet_step()
+    ops = top_ops_report(step, a, b, steps=2, top=8)
+    rows = join_roofline(ops, hlo)
+    for r in rows:
+        r["name"] = r["name"][:80]
+    print("TOPOPS_JSON:" + json.dumps(rows), flush=True)
+    sys.exit(0)
+
+
+def _topops_subprocess(which, timeout=1500):
+    """Run the top-ops capture in a child process; returns the parsed
+    rows or [{"error": ...}]."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ, BENCH_TOPOPS_CHILD=which)
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=timeout)
+        for line in out.stdout.splitlines():
+            if line.startswith("TOPOPS_JSON:"):
+                return json.loads(line[len("TOPOPS_JSON:"):])
+        return [{"error": ("no TOPOPS_JSON in child output; stderr tail: "
+                           + out.stderr[-200:])}]
+    except Exception as e:
+        return [{"error": repr(e)[:200]}]
+
+
+def _build_gpt_step():
+    """(warmed jitted step fn, args..., compiled HLO text) for the GPT
+    bench config — same construction as the throughput bench
+    (_gpt_setup), wrapped in a donation-chaining closure."""
+    train_step, params, opt_state, tokens, labels, _, _ = _gpt_setup()
+    hlo = train_step.lower(params, opt_state, tokens,
+                           labels).compile().as_text()
+    state = {"p": params, "o": opt_state}
+
+    def step(t, l):
+        state["p"], state["o"], loss = train_step(state["p"], state["o"],
+                                                  t, l)
+        return loss
+
+    float(step(tokens, labels))
+    return step, tokens, labels, hlo
+
+
+def _build_resnet_step():
+    """Same contract as _build_gpt_step for the ResNet bench config."""
+    (train_step, params, bn_state, opt_state, scale_state,
+     x, y) = _resnet_setup()
+    hlo = train_step.lower(params, bn_state, opt_state, scale_state,
+                           x, y).compile().as_text()
+    state = {"p": params, "bn": bn_state, "o": opt_state, "s": scale_state}
+
+    def step(x, y):
+        state["p"], state["bn"], state["o"], state["s"], loss = train_step(
+            state["p"], state["bn"], state["o"], state["s"], x, y)
+        return loss
+
+    float(step(x, y))
+    return step, x, y, hlo
 
 
 def main():
@@ -544,11 +779,18 @@ def main():
         extras[f"{name}_error"] = err
         return None
 
+    # bench_schema 2 (r4): kernel microbenches time on DEVICE clocks
+    # (profiler traces) with host-slope fallback, each entry carrying a
+    # "timing" field; top-ops captured in subprocesses, default ON.
+    # The kernel-defaults CI gate (tests/L0/test_kernel_defaults.py)
+    # only enforces records with bench_schema >= 2.
+    extras["bench_schema"] = 2
+
     roof = attempt("matmul_roof", bench_matmul_roof)
-    if roof:
+    if roof is not None:
         extras["matmul_roof_tflops"] = round(roof, 1)
     hbm = attempt("hbm_roof", bench_hbm_roof)
-    if hbm:
+    if hbm is not None:
         extras["hbm_roof_gb_s"] = round(hbm, 1)
 
     note("resnet50...")
@@ -556,46 +798,62 @@ def main():
     extras["resnet50_analytic_tflops"] = round(rn_tflops, 1)
     extras["resnet50_cost_analysis_tflops"] = round(rn_cost_tflops, 1)
     extras["resnet50_final_loss"] = round(rn_loss, 3)
-    if roof:
+    if roof is not None:
         extras["resnet50_mfu_vs_roof"] = round(rn_tflops / roof, 3)
 
     if not FAST:
         gpt = attempt("gpt350m", bench_gpt350m)
-        if gpt:
-            tok_s, model_tf, hw_tf, cost_tf, policy, top_ops = gpt
+        if gpt is not None:
+            tok_s, model_tf, hw_tf, cost_tf, policy, _ = gpt
             extras["gpt350m_tokens_per_sec"] = round(tok_s, 0)
             extras["gpt350m_model_tflops"] = round(model_tf, 1)
             extras["gpt350m_hw_tflops"] = round(hw_tf, 1)
             extras["gpt350m_cost_analysis_tflops"] = round(cost_tf, 1)
             extras["gpt350m_remat_policy"] = policy
-            extras["gpt350m_top_ops"] = top_ops
-            if roof:
+            if roof is not None:
                 extras["gpt350m_mfu_vs_roof"] = round(model_tf / roof, 3)
 
+        if os.environ.get("BENCH_TOP_OPS", "1") != "0":
+            note("gpt350m top-ops (subprocess)...")
+            extras["gpt350m_top_ops"] = _topops_subprocess("gpt")
+            note("resnet50 top-ops (subprocess)...")
+            extras["resnet50_top_ops"] = _topops_subprocess("resnet")
+
         r = attempt("flash_attention_s1024",
-                    lambda: bench_attention_kernel(128, 1024, 64, 512, 512))
-        if r:
-            if roof:
+                    lambda: bench_attention_kernel(128, 1024, 64, 512, 512,
+                                                   measure_floor=True))
+        if r is not None:
+            if roof is not None:
                 r["fwd_frac_of_roof"] = round(r["fwd_tflops"] / roof, 3)
+            if "dot_floor_tflops" in r and r["dot_floor_tflops"] > 0:
+                # the honest ceiling at d=64 (half the MXU lanes): the
+                # bwd's attainable best is this floor over fwd+bwd work
+                r["fwdbwd_frac_of_dot_floor"] = round(
+                    r["fwdbwd_tflops"] / r["dot_floor_tflops"], 3)
             extras["flash_attention_s1024"] = r
         r = attempt("flash_attention_s4096",
-                    lambda: bench_attention_kernel(16, 4096, 128, 1024, 1024))
-        if r:
-            if roof:
+                    lambda: bench_attention_kernel(16, 4096, 128, 512, 512))
+        if r is not None:
+            if roof is not None:
                 r["fwd_frac_of_roof"] = round(r["fwd_tflops"] / roof, 3)
+                r["fwdbwd_frac_of_roof"] = round(
+                    r["fwdbwd_tflops"] / roof, 3)
             extras["flash_attention_s4096"] = r
         r = attempt("layer_norm", bench_layernorm_kernel)
-        if r:
-            if hbm:
+        if r is not None:
+            if hbm is not None:
                 r["fwd_frac_of_hbm"] = round(
                     r["fwd_pallas_gb_s"] / hbm, 3)
             extras["layer_norm"] = r
         r = attempt("fused_softmax", bench_softmax_kernel)
-        if r:
+        if r is not None:
             extras["fused_softmax"] = r
         r = attempt("xentropy", bench_xentropy_kernel)
-        if r:
+        if r is not None:
             extras["xentropy"] = r
+        r = attempt("fused_linear_xent", bench_fused_linear_xent)
+        if r is not None:
+            extras["fused_linear_xent"] = r
 
     baseline = None
     try:
@@ -615,4 +873,8 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    _child = os.environ.get("BENCH_TOPOPS_CHILD")
+    if _child:
+        _topops_child(_child)
+    else:
+        main()

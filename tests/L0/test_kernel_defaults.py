@@ -1,0 +1,77 @@
+"""Win-or-fall-back CI gate: the newest committed bench record must show
+every default-on fused path non-losing (ops/kernel_defaults.py)."""
+import glob
+import json
+import os
+
+import pytest
+
+from apex_tpu.ops.kernel_defaults import DEFAULT_GATES
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _round_key(path):
+    """Natural sort on the round number: BENCH_r10 must sort after
+    BENCH_r9 (lexicographic sort would silently enforce a stale record
+    from round 10 on).  Suffixed builder records (e.g. r03b_builder)
+    sort after the same round's driver record via the string tail."""
+    import re
+
+    name = os.path.basename(path)
+    m = re.match(r"BENCH_r(\d+)(.*)\.json$", name)
+    if not m:
+        return (-1, name)
+    return (int(m.group(1)), m.group(2))
+
+
+def _latest_record():
+    paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")),
+                   key=_round_key)
+    for path in reversed(paths):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except Exception:
+            continue
+        extras = rec.get("parsed", {}).get("extras", {})
+        if extras.get("bench_schema", 0) >= 2:
+            return os.path.basename(path), extras
+    return None, None
+
+
+def test_every_default_wins_in_latest_record():
+    name, extras = _latest_record()
+    if extras is None:
+        pytest.skip("no bench_schema>=2 record committed yet (enforcement "
+                    "begins with the first device-timed record)")
+    failures = []
+    for entry, field, min_val, guards in DEFAULT_GATES:
+        section = extras.get(entry)
+        if not isinstance(section, dict) or field not in section:
+            continue  # entry lost to a transient bench failure: no verdict
+        val = section[field]
+        if val < min_val:
+            failures.append(
+                f"{name}: {entry}.{field} = {val} < {min_val} — losing "
+                f"default: {guards}")
+    assert not failures, "\n".join(failures)
+
+
+def test_gate_covers_every_speedup_field():
+    """Every *speedup* field the bench emits must be claimed by a gate —
+    a new fused path cannot ship default-on without enforcement."""
+    name, extras = _latest_record()
+    if extras is None:
+        pytest.skip("no bench_schema>=2 record committed yet")
+    gated = {(e, f) for e, f, _, _ in DEFAULT_GATES}
+    ungated = []
+    for entry, section in extras.items():
+        if not isinstance(section, dict):
+            continue
+        for field in section:
+            if "speedup" in field and (entry, field) not in gated:
+                ungated.append(f"{entry}.{field}")
+    assert not ungated, (
+        f"{name}: speedup fields without a kernel_defaults gate: {ungated}")

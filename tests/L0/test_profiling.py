@@ -154,3 +154,50 @@ class TestTraceReport:
         assert isinstance(table, str)
         for o in ops:
             assert o.total_ms >= 0 and o.calls >= 1
+
+
+class TestRooflineJoin:
+    """hlo_fusion_flops / join_roofline: the pyprof measured-time x
+    derived-flops join (VERDICT r3 missing #2)."""
+
+    def test_matmul_flops_exact_from_hlo(self):
+        from apex_tpu.profiling.trace_report import hlo_fusion_flops
+
+        # exact for 2-tensor contractions: [M,K]x[K,N] -> 2MNK
+        hlo = """
+%fused_computation.1 (p0: f32[64,32], p1: f32[32,48]) -> f32[64,48] {
+  %p0 = f32[64,32]{1,0} parameter(0)
+  %p1 = f32[32,48]{1,0} parameter(1)
+  ROOT %d = f32[64,48]{1,0} dot(%p0, %p1), lhs_contracting_dims={1}
+}
+ENTRY %main {
+  %x = f32[64,32]{1,0} parameter(0)
+  %y = f32[32,48]{1,0} parameter(1)
+  %fusion.1 = f32[64,48]{1,0} fusion(%x, %y), kind=kOutput, calls=%fused_computation.1, metadata={op_name="jit(f)/dot_general" source_file="x.py"}
+}
+"""
+        fl = hlo_fusion_flops(hlo)
+        assert "fusion.1" in fl
+        flops, op_name = fl["fusion.1"]
+        assert flops == pytest.approx(2 * 64 * 32 * 48)
+        assert "dot_general" in op_name
+
+    def test_join_on_real_compiled_program(self):
+        from apex_tpu.profiling.trace_report import (
+            hlo_fusion_flops, join_roofline)
+        from apex_tpu.profiling import top_ops_report
+
+        w = jnp.ones((128, 128), jnp.float32)
+
+        @jax.jit
+        def f(x):
+            return jnp.tanh(x @ w) @ w
+
+        x = jnp.ones((128, 128))
+        float(f(x).sum())
+        hlo = f.lower(x).compile().as_text()
+        fl = hlo_fusion_flops(hlo)
+        # parser must not crash on a real program; rows join cleanly
+        ops = top_ops_report(f, x, steps=2)
+        rows = join_roofline(ops, hlo, roof_tflops=100.0)
+        assert all("ms" in r and "est_gflops" in r for r in rows)
