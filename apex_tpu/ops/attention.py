@@ -680,9 +680,17 @@ def _flash_fwd_dispatch(q, k, v, mask_bias, seg_q, seg_k, dropout_seed,
 
 def _flash_fwd_rule(q, k, v, mask_bias, seg_q, seg_k, dropout_seed,
                     scale, causal, block_q, block_k, dropout_rate):
+    from jax.ad_checkpoint import checkpoint_name
+
     o, lse = _flash_fwd_dispatch(q, k, v, mask_bias, seg_q, seg_k,
                                  dropout_seed, scale, causal, block_q,
                                  block_k, dropout_rate)
+    # remat hook: under jax.checkpoint, the backward regenerates these
+    # residuals by RERUNNING the forward kernel — naming them lets a
+    # save_only_these_names policy keep (o, lse) and skip that rerun
+    # (GPTConfig remat_policy="attn_res"); the tags are inert otherwise
+    o = checkpoint_name(o, "flash_attn_out")
+    lse = checkpoint_name(lse, "flash_attn_lse")
     return o, (q, k, v, mask_bias, seg_q, seg_k, dropout_seed, o, lse)
 
 

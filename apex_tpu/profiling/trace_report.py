@@ -265,6 +265,26 @@ def hlo_fusion_flops(hlo_text: str) -> Dict[str, tuple]:
         end = names[i + 1].start() if i + 1 < len(names) else len(hlo_text)
         bodies[m.group(1)] = hlo_text[m.start():end]
 
+    _ITEM = {"f64": 8, "s64": 8, "u64": 8, "f32": 4, "s32": 4, "u32": 4,
+             "bf16": 2, "f16": 2, "s16": 2, "u16": 2,
+             "s8": 1, "u8": 1, "pred": 1, "f8": 1}
+
+    def comp_bytes(comp: str) -> float:
+        """HBM traffic estimate for one executed computation: its
+        parameter + result tensors (each read/written once — the
+        fusion boundary traffic; in-body temporaries stay in
+        registers/VMEM)."""
+        body = bodies.get(comp)
+        if body is None:
+            return 0.0
+        sig = body[:body.find("{")]
+        total = 0.0
+        for t, shape in re.findall(r"(\w+)\[([\d,]*)\]", sig):
+            n = float(np.prod([int(x) for x in shape.split(",") if x])) \
+                if shape else 1.0
+            total += n * _ITEM.get(t, 4)
+        return total
+
     memo: Dict[str, float] = {}
 
     def comp_flops(comp: str, stack=()) -> float:
@@ -286,9 +306,10 @@ def hlo_fusion_flops(hlo_text: str) -> Dict[str, tuple]:
         inst, comp = m.group(1), m.group(2)
         line = hlo_text[m.start():hlo_text.find("\n", m.start())]
         nm = re.search(r'op_name="([^"]*)"', line)
-        out.setdefault(inst, (comp_flops(comp), nm.group(1) if nm else ""))
+        out.setdefault(inst, (comp_flops(comp), comp_bytes(comp),
+                              nm.group(1) if nm else ""))
     for comp in bodies:  # trace rows sometimes carry the COMPUTATION name
-        out.setdefault(comp, (comp_flops(comp), ""))
+        out.setdefault(comp, (comp_flops(comp), comp_bytes(comp), ""))
     # every remaining instruction still gets its op_name label — custom
     # calls (Pallas kernels) are opaque to flops parsing (est 0, like
     # XLA's own cost analysis) but their source identity matters most:
@@ -296,7 +317,7 @@ def hlo_fusion_flops(hlo_text: str) -> Dict[str, tuple]:
     for m in re.finditer(
             r"^\s*(?:ROOT )?%([\w.-]+) = [^\n]*?"
             r'op_name="([^"]*)"', hlo_text, re.M):
-        out.setdefault(m.group(1), (0.0, m.group(2)))
+        out.setdefault(m.group(1), (0.0, 0.0, m.group(2)))
     return out
 
 
@@ -308,12 +329,17 @@ def join_roofline(ops: Sequence[OpTime], hlo_text: str,
     fl = hlo_fusion_flops(hlo_text)
     rows = []
     for o in ops:
-        f, op_name = fl.get(o.name, (0.0, ""))
+        f, nbytes, op_name = fl.get(o.name, (0.0, 0.0, ""))
         t = o.total_ms / max(o.calls, 1) / 1e3
         tf = f / t / 1e12 if t > 0 else 0.0
         row = {"name": o.name, "ms": round(o.total_ms / max(o.calls, 1), 3),
                "calls": o.calls, "frac_of_device": round(o.frac_of_device, 3),
                "est_gflops": round(f / 1e9, 2), "achieved_tflops": round(tf, 1)}
+        if nbytes and t > 0:
+            # boundary-traffic bandwidth: the roofline's other axis —
+            # bandwidth-bound ops show GB/s near the HBM roof with low TF
+            row["est_mb"] = round(nbytes / 1e6, 1)
+            row["achieved_gb_s"] = round(nbytes / t / 1e9, 1)
         if op_name:
             # keep the informative tail (op + source), not the jit prefix
             row["op"] = op_name[-80:]

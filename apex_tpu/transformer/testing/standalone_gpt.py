@@ -375,6 +375,16 @@ class ParallelTransformer:
             # memory ceiling but skips recomputing the matmuls (the flops).
             if self.cfg.remat_policy == "dots":
                 policy = jax.checkpoint_policies.dots_saveable
+            elif self.cfg.remat_policy == "attn_res":
+                # save the flash kernel's RESIDUALS (o, lse — named in
+                # ops/attention._flash_fwd_rule): the backward then
+                # consumes them directly instead of re-running the
+                # attention forward inside the remat region (saving the
+                # module OUTPUT alone cannot do this — the custom_vjp
+                # backward needs o and lse, so remat reruns the kernel
+                # to rebuild them)
+                policy = jax.checkpoint_policies.save_only_these_names(
+                    "flash_attn_out", "flash_attn_lse")
             elif self.cfg.remat_policy == "attn_out":
                 # keep the flash-attention output per layer (named above):
                 # +16 MB/layer at the 350M shape, and the recompute no

@@ -298,18 +298,20 @@ def bench_resnet():
 GPT_L, GPT_H, GPT_V, GPT_SEQ = 24, 1024, 51200, 1024
 
 
-def gpt_analytic_flops(n_tokens, batch, *, with_remat=False):
+def gpt_analytic_flops(n_tokens, batch, *, with_remat=False,
+                       remat_attn=True):
     """Analytic fwd+bwd matmul flops for the 350M GPT (causal attention
     counted at half density).  ``with_remat`` adds the transformer-body
-    forward recompute that remat="full" performs — the *hardware* flops,
-    vs the model flops used for MFU."""
+    forward recompute that per-layer remat performs — the *hardware*
+    flops, vs the model flops used for MFU; ``remat_attn=False``
+    (the "attn_res" policy) excludes the attention from the recompute."""
     body = 2 * 12 * GPT_H * GPT_H * GPT_L * n_tokens
     attn = 2 * 2 * batch * GPT_SEQ * GPT_SEQ * GPT_H * GPT_L / 2
     logits = 2 * n_tokens * GPT_H * GPT_V
     fwd = body + attn + logits
     total = 3 * fwd
     if with_remat:
-        total += body + attn
+        total += body + (attn if remat_attn else 0)
     return total
 
 
@@ -325,7 +327,11 @@ def _gpt_setup():
     from apex_tpu.transformer.testing import GPTConfig, GPTModel
 
     B = int(os.environ.get("BENCH_GPT_BATCH", "8"))
-    remat_policy = os.environ.get("BENCH_GPT_REMAT", "full")
+    # attn_res: full-layer remat but the flash kernel's (o, lse)
+    # residuals are saved, so the backward does not re-run the attention
+    # forward — measured-best policy (interleaved vs "full": 222.4 vs
+    # 226.7 ms/step at B=8; see BASELINE.md r4 remat sweep)
+    remat_policy = os.environ.get("BENCH_GPT_REMAT", "attn_res")
     cfg = GPTConfig(num_layers=GPT_L, hidden_size=GPT_H,
                     num_attention_heads=16, vocab_size=GPT_V,
                     max_position_embeddings=GPT_SEQ,
@@ -390,8 +396,16 @@ def bench_gpt350m():
     assert jnp.isfinite(final), f"gpt diverged: {final}"
     n_tok = B * GPT_SEQ
     model_fl = gpt_analytic_flops(n_tok, B)
-    hw_fl = gpt_analytic_flops(n_tok, B,
-                               with_remat=(remat_policy == "full"))
+    # matmul-flops recompute by policy: "full"/"attn_out" re-run the
+    # whole layer (attn_out saves only the module output, which the
+    # custom_vjp backward cannot use — it reruns the kernel for
+    # residuals); "attn_res" saves the kernel residuals so only the
+    # body matmuls re-run; "dots" saves matmul outputs so the recompute
+    # is elementwise-only (zero matmul flops)
+    hw_fl = gpt_analytic_flops(
+        n_tok, B,
+        with_remat=(remat_policy in ("full", "attn_out", "attn_res")),
+        remat_attn=(remat_policy != "attn_res"))
     return (n_tok / best_dt, model_fl / best_dt / 1e12,
             hw_fl / best_dt / 1e12, cost_flops / best_dt / 1e12,
             remat_policy, None)

@@ -178,8 +178,10 @@ ENTRY %main {
 """
         fl = hlo_fusion_flops(hlo)
         assert "fusion.1" in fl
-        flops, op_name = fl["fusion.1"]
+        flops, nbytes, op_name = fl["fusion.1"]
         assert flops == pytest.approx(2 * 64 * 32 * 48)
+        # boundary traffic: two fp32 params + fp32 result
+        assert nbytes == pytest.approx((64 * 32 + 32 * 48 + 64 * 48) * 4)
         assert "dot_general" in op_name
 
     def test_join_on_real_compiled_program(self):
