@@ -1,0 +1,213 @@
+"""Megatron-style GPT pretraining CLI on TPU meshes.
+
+The user-facing counterpart of the reference's canonical GPT loop
+(reference tests/L0/run_transformer/run_megatron_gpt_pipeline.py, itself
+modeled on Megatron-LM's pretrain_gpt.py): build a GPT from the Megatron
+argument surface (``apex_tpu.transformer.testing.arguments`` — the
+argparse clone of reference testing/arguments.py:23-806), train with
+data/tensor parallelism on a device mesh, checkpoint and resume.
+
+Runs unchanged on one real TPU chip or an emulated CPU mesh:
+
+    # 350M-class single chip
+    python pretrain_gpt.py --num-layers 24 --hidden-size 1024 \\
+        --num-attention-heads 16 --seq-length 1024 --micro-batch-size 8
+
+    # emulated 8-way (2-way tensor x 4-way data) on CPU
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
+    python pretrain_gpt.py --tensor-model-parallel-size 2 \\
+        --num-layers 4 --hidden-size 128 --num-attention-heads 4 \\
+        --seq-length 128 --micro-batch-size 2 --train-iters 20
+
+Data is synthetic token streams by default (the reference test loop does
+the same); pass ``--data-path`` (the Megatron flag) pointing at binary token files
+(uint32 token-id records of seq+1 each) to stream real tokens through
+the native prefetching record loader; ``--save``/``--save-interval``/
+``--load`` give checkpoint/resume.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.experimental.shard_map import shard_map  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from apex_tpu import checkpoint as ckpt  # noqa: E402
+from apex_tpu import multi_tensor, optimizers  # noqa: E402
+from apex_tpu.transformer import parallel_state  # noqa: E402
+from apex_tpu.transformer.testing import GPTConfig, GPTModel  # noqa: E402
+from apex_tpu.transformer.testing.arguments import parse_args  # noqa: E402
+
+
+def _extra_args(parser):
+    # --data-path / --save / --save-interval / --load come from the
+    # Megatron argument clone (arguments.py); only add what it lacks
+    g = parser.add_argument_group("pretrain_gpt")
+    g.add_argument("--remat-policy", default="attn_res",
+                   choices=["full", "dots", "attn_res", "attn_out"])
+    g.add_argument("--vocab-size", type=int, default=51200,
+                   help="unpadded vocab; padded to "
+                        "--make-vocab-size-divisible-by x tp")
+    return parser
+
+
+def build_config(args) -> GPTConfig:
+    # pad the vocab so every TP rank gets equal shards (the reference's
+    # _vocab_size_with_padding, arguments.py make-vocab-size-divisible-by)
+    mult = args.make_vocab_size_divisible_by * args.tensor_model_parallel_size
+    args.padded_vocab_size = ((args.vocab_size + mult - 1) // mult) * mult
+    return GPTConfig(
+        num_layers=args.num_layers,
+        hidden_size=args.hidden_size,
+        num_attention_heads=args.num_attention_heads,
+        vocab_size=args.padded_vocab_size,
+        max_position_embeddings=args.max_position_embeddings,
+        tp_size=args.tensor_model_parallel_size,
+        bf16=args.bf16,
+        fp16=args.fp16,
+        attention_dropout=args.attention_dropout,
+        hidden_dropout=args.hidden_dropout,
+        use_flash_attention=True,
+        remat=args.num_layers >= 12,
+        remat_policy=args.remat_policy,
+    )
+
+
+def token_batches(args, key):
+    """Yield (tokens, labels) [global_batch, seq] int32 forever."""
+    b, s = args.global_batch_size, args.seq_length
+    if args.data_path:
+        from apex_tpu.data import RecordLoader
+
+        # each record is one sequence of s+1 token ids (uint32)
+        loader = RecordLoader(list(args.data_path), record_bytes=4 * (s + 1),
+                              batch_size=b, shuffle=True, seed=args.seed)
+        for batch in loader:
+            ids = np.asarray(batch).view(np.uint32).reshape(b, s + 1)
+            ids = (ids % args.padded_vocab_size).astype(np.int32)
+            yield jnp.asarray(ids[:, :-1]), jnp.asarray(ids[:, 1:])
+    else:
+        while True:
+            key, k = jax.random.split(key)
+            ids = jax.random.randint(k, (b, s + 1), 0,
+                                     args.padded_vocab_size, jnp.int32)
+            yield ids[:, :-1], ids[:, 1:]
+
+
+def main(argv=None):
+    args = parse_args(extra_args_provider=_extra_args, args=argv,
+                      defaults={"train_iters": 100, "lr": 1.5e-4})
+    tp = args.tensor_model_parallel_size
+    n_dev = len(jax.devices())
+    dp = n_dev // tp
+    # the argument clone derives global batch from WORLD_SIZE env (the
+    # reference's launcher contract); here the mesh IS the world — one
+    # process, all local devices — so re-derive from the actual dp.
+    # No gradient-accumulation loop in this example: an explicit
+    # --global-batch-size must equal micro x dp.
+    args.data_parallel_size = dp
+    derived = args.micro_batch_size * dp
+    if args.global_batch_size not in (None, derived):
+        raise SystemExit(
+            f"--global-batch-size {args.global_batch_size} != "
+            f"micro-batch-size x dp = {derived}: gradient accumulation "
+            "is not wired in this example (see the pipeline schedules "
+            "for microbatched training)")
+    args.global_batch_size = derived
+
+    parallel_state.destroy_model_parallel()
+    mesh = parallel_state.initialize_model_parallel(
+        tp, 1, devices=jax.devices()[: tp * dp])
+    cfg = build_config(args)
+    model = GPTModel(cfg)
+
+    master = model.init_master(jax.random.PRNGKey(args.seed))
+    shards = [model.shard_master(master, r) for r in range(tp)]
+    params = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *shards)
+    opt = optimizers.FusedAdam(
+        lr=args.lr, weight_decay=args.weight_decay,
+        betas=(args.adam_beta1, args.adam_beta2), eps=args.adam_eps)
+    opt_state = opt.init(params)
+    clip = args.clip_grad if args.clip_grad and args.clip_grad > 0 else None
+    step0 = 0
+    if args.load:
+        (params, opt_state), step0 = ckpt.restore_checkpoint(
+            args.load, target=(params, opt_state))
+        print(f"resumed from step {step0}")
+
+    dropout_on = cfg.attention_dropout > 0 or cfg.hidden_dropout > 0
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(p, o, tokens, labels, rng):
+        def run(p, t, l):
+            p = jax.tree_util.tree_map(lambda a: a[0], p)  # this tp shard
+            key = rng if dropout_on else None
+            loss = jnp.mean(model.apply(p, t, labels=l, dropout_key=key))
+            # the reported loss must be the GLOBAL mean, not dp-rank 0's
+            # local micro-batch (reference
+            # average_losses_across_data_parallel_group)
+            return jax.lax.pmean(loss, "data")
+
+        def lossf(p):
+            # batch sharded over data, params sharded over tensor; the
+            # loss mean is averaged across the data axis
+            loss = shard_map(run, mesh=mesh,
+                             in_specs=(P("tensor"), P("data"), P("data")),
+                             out_specs=P(),
+                             check_rep=False)(p, tokens, labels)
+            return loss
+
+        loss, g = jax.value_and_grad(lossf)(p)
+        if clip is not None:
+            g, _ = multi_tensor.clip_grad_norm(g, clip)
+        p, o = opt.step(g, o, p)
+        return p, o, loss
+
+    if step0 >= args.train_iters:
+        print(f"nothing to do: resumed step {step0} >= --train-iters "
+              f"{args.train_iters}")
+        parallel_state.destroy_model_parallel()
+        return None
+    batches = token_batches(args, jax.random.PRNGKey(args.seed + 1))
+    for _ in range(step0):
+        next(batches)  # a resumed run must not re-see consumed batches
+    t0 = time.perf_counter()
+    loss = None
+    for it in range(step0, args.train_iters):
+        tokens, labels = next(batches)
+        rng = jax.random.fold_in(jax.random.PRNGKey(args.seed + 2), it)
+        params, opt_state, loss = train_step(params, opt_state, tokens,
+                                             labels, rng)
+        if (it + 1) % args.log_interval == 0:
+            dt = (time.perf_counter() - t0) / args.log_interval
+            tok_s = args.global_batch_size * args.seq_length / dt
+            print(f"iter {it + 1}/{args.train_iters} "
+                  f"loss {float(loss):.4f} {dt * 1e3:.0f} ms/iter "
+                  f"{tok_s:,.0f} tok/s", flush=True)
+            t0 = time.perf_counter()
+        if args.save and args.save_interval and \
+                (it + 1) % args.save_interval == 0:
+            ckpt.save_checkpoint(args.save, (params, opt_state), step=it + 1)
+    if args.save and not (args.save_interval
+                          and args.train_iters % args.save_interval == 0):
+        ckpt.save_checkpoint(args.save, (params, opt_state),
+                             step=args.train_iters)
+    assert loss is not None and bool(jnp.isfinite(loss)), "diverged"
+    print(f"done: final loss {float(loss):.4f}")
+    parallel_state.destroy_model_parallel()
+    return float(loss)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""examples/gpt/pretrain_gpt.py end-to-end on the emulated mesh: tp x dp
+training, checkpoint at the end, resume continues from the saved step
+(SURVEY.md L6 tier; reference run_megatron_gpt_pipeline.py role)."""
+import os
+import sys
+
+import pytest
+
+EX = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "examples", "gpt")
+
+
+@pytest.fixture()
+def pretrain():
+    sys.path.insert(0, EX)
+    import pretrain_gpt
+    yield pretrain_gpt
+    sys.path.remove(EX)
+
+
+def _args(tmp, extra=()):
+    return [
+        "--tensor-model-parallel-size", "2",
+        "--num-layers", "2", "--hidden-size", "64",
+        "--num-attention-heads", "2", "--seq-length", "64",
+        "--max-position-embeddings", "64",
+        "--micro-batch-size", "2", "--train-iters", "6",
+        "--lr", "1e-3", "--log-interval", "3", "--vocab-size", "512",
+        "--bf16", "--save", tmp, *extra,
+    ]
+
+
+def test_train_checkpoint_resume(pretrain, tmp_path):
+    tmp = str(tmp_path / "ckpt")
+    loss = pretrain.main(_args(tmp))
+    assert loss == pytest.approx(loss)  # finite
+    # a checkpoint at the final step exists and resume continues from it
+    import apex_tpu.checkpoint as ckpt
+
+    assert ckpt.latest_step(tmp) == 6
+    loss2 = pretrain.main(_args(tmp, ("--load", tmp,
+                                      "--train-iters", "8")))
+    assert ckpt.latest_step(tmp) == 8
+    assert loss2 == pytest.approx(loss2)
