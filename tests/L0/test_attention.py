@@ -510,3 +510,26 @@ def test_pallas_dropout_kernels_interpret_match_dense():
                                rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(np.asarray(dv), np.asarray(rv),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_bwd_vmem_guard_falls_back_for_large_shapes():
+    """Shapes whose fused-backward resident set exceeds the core VMEM
+    budget must route to the XLA blockwise backward (the guard added
+    with the one-pass kernel) — and small shapes must not."""
+    from apex_tpu.ops.attention import _BWD_VMEM_BUDGET, _pallas_bwd_ok
+
+    class Arr:
+        def __init__(self, shape, dtype=jnp.bfloat16):
+            self.shape = shape
+            self.dtype = jnp.dtype(dtype)
+
+    big = Arr((1, 16384, 256))
+    assert not _pallas_bwd_ok(big, big, None, 512, 512)
+    # estimate for the big shape really is over budget
+    small = Arr((8, 1024, 64))
+    # off-TPU _pallas_ok is False; assert only the budget arithmetic by
+    # checking the big shape trips even if the backend check passed
+    sq, d = big.shape[1], big.shape[2]
+    resident_min = 3 * sq * d * 2 + sq * d * 4
+    assert resident_min > _BWD_VMEM_BUDGET
+    assert small.shape[1] * small.shape[2] * 8 < _BWD_VMEM_BUDGET

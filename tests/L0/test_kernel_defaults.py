@@ -75,3 +75,34 @@ def test_gate_covers_every_speedup_field():
                 ungated.append(f"{entry}.{field}")
     assert not ungated, (
         f"{name}: speedup fields without a kernel_defaults gate: {ungated}")
+
+
+def test_gate_fails_on_losing_default(tmp_path, monkeypatch):
+    """The failure path: a record showing a losing default must trip the
+    gate (the r3 scenario — 0.17x recorded for a default-on path)."""
+    import tests.L0.test_kernel_defaults as mod
+
+    rec = {"parsed": {"extras": {
+        "bench_schema": 2,
+        "layer_norm": {"fwd_speedup": 1.5, "bwd_speedup": 0.17},
+    }}}
+    p = tmp_path / "BENCH_r99.json"
+    p.write_text(json.dumps(rec))
+    monkeypatch.setattr(mod, "REPO", str(tmp_path))
+    with pytest.raises(AssertionError, match="bwd_speedup = 0.17"):
+        mod.test_every_default_wins_in_latest_record()
+
+
+def test_natural_sort_picks_double_digit_rounds(tmp_path, monkeypatch):
+    import tests.L0.test_kernel_defaults as mod
+
+    old = {"parsed": {"extras": {"bench_schema": 2,
+                                 "xentropy": {"speedup": 0.1}}}}
+    newer = {"parsed": {"extras": {"bench_schema": 2,
+                                   "xentropy": {"speedup": 1.0}}}}
+    (tmp_path / "BENCH_r09.json").write_text(json.dumps(old))
+    (tmp_path / "BENCH_r10.json").write_text(json.dumps(newer))
+    monkeypatch.setattr(mod, "REPO", str(tmp_path))
+    name, extras = mod._latest_record()
+    assert name == "BENCH_r10.json"
+    assert extras["xentropy"]["speedup"] == 1.0
