@@ -648,10 +648,12 @@ def _pallas_bwd_ok(q, k, mask_bias, block_q, block_k):
     bk = min(block_k, sk)
     item = q.dtype.itemsize
     resident = (
-        2 * sq * d * item      # q, do streams (whole per batch-head)
-        + sq * d * 4           # dq fp32 accumulator scratch
-        + sq * d * item        # dq output block
-        + 2 * sq * 4           # lse + delta
+        # whole-bh streams are pipeline double-buffered across the bh
+        # grid dimension, same as the blocked operands below
+        2 * 2 * sq * d * item  # q, do streams (whole per batch-head) ×2
+        + sq * d * 4           # dq fp32 accumulator scratch (not piped)
+        + 2 * sq * d * item    # dq output block ×2 buffers
+        + 2 * 2 * sq * 4       # lse + delta ×2 buffers
         + 2 * (4 * bk * d * item + 2 * bk * d * 4)  # k/v/dk/dv ×2 buffers
     )
     if mask_bias is not None:

@@ -148,12 +148,13 @@ def parse_trace_dir(logdir: str, *, device_only: bool = True
 
 
 def top_ops_report(fn: Callable, *args, steps: int = 3,
-                   logdir: Optional[str] = None, top: int = 10,
+                   logdir: Optional[str] = None, top: Optional[int] = 10,
                    **kwargs) -> List[OpTime]:
     """Run ``fn(*args, **kwargs)`` ``steps`` times under the profiler and
     return the top-k ops by measured device time (pyprof prof.py's
-    output table, TPU-native).  ``fn`` should already be jitted and
-    warmed (compile inside the trace would dominate)."""
+    output table, TPU-native); ``top=None`` returns every parsed op.
+    ``fn`` should already be jitted and warmed (compile inside the trace
+    would dominate)."""
     owndir = logdir is None
     logdir = logdir or tempfile.mkdtemp(prefix="apex_tpu_prof_")
     try:
@@ -192,7 +193,10 @@ def device_time_ms(fn: Callable, *args, steps: int = 4,
     per invocation, and dividing by calls would count one body iteration
     instead of all of them.  Raises if the trace is empty, so callers
     can fall back to wall-clock timing."""
-    ops = top_ops_report(fn, *args, steps=steps, top=256, **kwargs)
+    # top=None: sum EVERY parsed op — a top-k cap here would silently
+    # undercount device time for programs with many distinct fusions and
+    # inflate speedups computed from the ratio
+    ops = top_ops_report(fn, *args, steps=steps, top=None, **kwargs)
     tot = sum(o.total_ms for o in ops
               if not o.name.startswith(tuple(exclude))) / steps
     if tot <= 0:
@@ -228,16 +232,31 @@ def _body_flops(body: str) -> float:
             sizes[m.group(1)] = float(np.prod(
                 [int(x) for x in shape.split(",") if x])) if shape else 1.0
     flops = 0.0
+    # anchor the operand scan on the OPCODE's paren, not the first paren
+    # after "= ": tuple-typed results ("%f = (f32[..], f32[..]) fusion(")
+    # put a paren in the type position and would hijack the scan
+    op_re = re.compile(r"\s(?:dot|dot-general|convolution)\(")
+    name_re = re.compile(r"^\s*(?:ROOT )?%([\w.-]+) = ")
+    shape_re = re.compile(r"\[([\d,]*)\]")
     for line in body.splitlines():
-        m = def_re.match(line)
-        if m is None:
+        om = op_re.search(line)
+        if om is None:
             continue
-        if not (" dot(" in line or "dot-general" in line
-                or " convolution(" in line):
+        nm = name_re.match(line)
+        if nm is None:
             continue
-        out_sz = sizes[m.group(1)]
-        call = line[line.index("(", line.index("= ")):]
-        operands = re.findall(r"%([\w.-]+)", call.split("),")[0])
+        out_sz = sizes.get(nm.group(1))
+        if out_sz is None:
+            # tuple-typed result: size from the first shape literal in
+            # the type position (before the opcode)
+            sm = shape_re.search(line[:om.start()])
+            if sm is None:
+                continue
+            shape = sm.group(1)
+            out_sz = float(np.prod(
+                [int(x) for x in shape.split(",") if x])) if shape else 1.0
+        call = line[om.end() - 1:]
+        operands = re.findall(r"%([\w.-]+)", call.split(")")[0])
         ops_sz = [sizes.get(o) for o in operands[:2]]
         if len(ops_sz) < 2 or None in ops_sz:
             continue

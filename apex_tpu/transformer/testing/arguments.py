@@ -62,6 +62,9 @@ def _validate_and_derive(args, defaults):
             args.world_size = 1
     args.rank = int(os.getenv("RANK", "0"))
 
+    assert args.tensor_model_parallel_size >= 1, (
+        f"tensor model parallel size "
+        f"({args.tensor_model_parallel_size}) must be >= 1")
     args.tensor_model_parallel_size = min(
         args.tensor_model_parallel_size, args.world_size)
     assert args.world_size % args.tensor_model_parallel_size == 0, (

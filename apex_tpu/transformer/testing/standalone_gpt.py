@@ -387,9 +387,13 @@ class ParallelTransformer:
                     "flash_attn_out", "flash_attn_lse")
             elif self.cfg.remat_policy == "attn_out":
                 # keep the flash-attention output per layer (named above):
-                # +16 MB/layer at the 350M shape, and the recompute no
-                # longer re-runs the attention kernel — measured ~7% off
-                # the step at B=8 (BASELINE.md r4 remat sweep)
+                # +16 MB/layer at the 350M shape.  This only removes
+                # recompute of ops DOWNSTREAM of the saved output — the
+                # flash custom_vjp backward still needs its (o, lse)
+                # residuals, so remat re-runs the kernel to rebuild them
+                # (only attn_res skips the kernel re-run; bench.py's
+                # hw-flops accounting sets remat_attn=True here).
+                # Measured ~7% off the step at B=8 (BASELINE.md r4 sweep)
                 policy = jax.checkpoint_policies.save_only_these_names(
                     "attn_out")
             else:

@@ -773,6 +773,42 @@ def _build_resnet_step():
     return step, x, y, hlo
 
 
+SUMMARY_LINE_LIMIT = 1800  # the driver records only a ~2000-char stdout tail
+TOPOPS_SIDECAR = "BENCH_TOPOPS.json"
+
+
+def _emit_record(record, limit=SUMMARY_LINE_LIMIT):
+    """Return (summary line, spilled sections) with the line guaranteed
+    under ``limit`` chars.
+
+    The driver captures a ~2000-char tail of stdout and parses the last
+    JSON line; the r4 record embedded full top-ops tables in that line
+    and came back ``parsed: null`` — an official perf artifact carrying
+    zero metrics (VERDICT r4 Weak #2).  Bulk tables now go to the
+    :data:`TOPOPS_SIDECAR` file before this is called; as a final guard,
+    the largest remaining extras sections are spilled (largest first,
+    named in ``extras["spilled_to_sidecar"]``) until the line fits, so
+    the record can never again defeat the driver's parser."""
+    extras = record.get("extras", {})
+    spilled = {}
+    line = json.dumps(record)
+    while len(line) > limit:
+        # dict/list sections AND long strings (e.g. a relay-down run
+        # leaves many ~200-char *_error strings — those alone recreated
+        # the oversized-line incident in review) are spill candidates
+        bulky = [k for k, v in extras.items()
+                 if (isinstance(v, (dict, list))
+                     or (isinstance(v, str) and len(v) > 60))
+                 and k != "spilled_to_sidecar"]
+        if not bulky:
+            break
+        key = max(bulky, key=lambda k: len(json.dumps(extras[k])))
+        spilled[key] = extras.pop(key)
+        extras.setdefault("spilled_to_sidecar", []).append(key)
+        line = json.dumps(record)
+    return line, spilled
+
+
 def main():
     import sys
 
@@ -796,9 +832,12 @@ def main():
     # bench_schema 2 (r4): kernel microbenches time on DEVICE clocks
     # (profiler traces) with host-slope fallback, each entry carrying a
     # "timing" field; top-ops captured in subprocesses, default ON.
+    # bench_schema 3 (r5): top-ops tables move to the BENCH_TOPOPS.json
+    # sidecar and the summary line is size-guarded (_emit_record) so the
+    # driver's tail capture always parses.
     # The kernel-defaults CI gate (tests/L0/test_kernel_defaults.py)
-    # only enforces records with bench_schema >= 2.
-    extras["bench_schema"] = 2
+    # enforces records with bench_schema >= 2.
+    extras["bench_schema"] = 3
 
     roof = attempt("matmul_roof", bench_matmul_roof)
     if roof is not None:
@@ -827,11 +866,14 @@ def main():
             if roof is not None:
                 extras["gpt350m_mfu_vs_roof"] = round(model_tf / roof, 3)
 
+    sidecar = {}
+    if not FAST:
         if os.environ.get("BENCH_TOP_OPS", "1") != "0":
             note("gpt350m top-ops (subprocess)...")
-            extras["gpt350m_top_ops"] = _topops_subprocess("gpt")
+            sidecar["gpt350m_top_ops"] = _topops_subprocess("gpt")
             note("resnet50 top-ops (subprocess)...")
-            extras["resnet50_top_ops"] = _topops_subprocess("resnet")
+            sidecar["resnet50_top_ops"] = _topops_subprocess("resnet")
+            extras["top_ops_file"] = TOPOPS_SIDECAR
 
         r = attempt("flash_attention_s1024",
                     lambda: bench_attention_kernel(128, 1024, 64, 512, 512,
@@ -877,13 +919,22 @@ def main():
                 "resnet50_images_per_sec")
     except Exception:
         pass
-    print(json.dumps({
+    line, spilled = _emit_record({
         "metric": "resnet50_amp_o2_fusedlamb_images_per_sec",
         "value": round(ips, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(ips / baseline, 3) if baseline else 1.0,
         "extras": extras,
-    }))
+    })
+    sidecar.update(spilled)
+    if sidecar:
+        try:
+            with open(os.path.join(os.path.dirname(
+                    os.path.abspath(__file__)), TOPOPS_SIDECAR), "w") as f:
+                json.dump(sidecar, f, indent=1)
+        except OSError as e:
+            note(f"sidecar write failed: {e!r}")
+    print(line)
 
 
 if __name__ == "__main__":

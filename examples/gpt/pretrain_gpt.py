@@ -110,6 +110,11 @@ def main(argv=None):
                       defaults={"train_iters": 100, "lr": 1.5e-4})
     tp = args.tensor_model_parallel_size
     n_dev = len(jax.devices())
+    if tp < 1 or n_dev % tp:
+        raise SystemExit(
+            f"--tensor-model-parallel-size {tp} must be >= 1 and divide "
+            f"the device count ({n_dev} visible): tp > devices gives an "
+            "empty mesh and a non-divisor silently drops devices")
     dp = n_dev // tp
     # the argument clone derives global batch from WORLD_SIZE env (the
     # reference's launcher contract); here the mesh IS the world — one

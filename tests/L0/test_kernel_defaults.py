@@ -1,8 +1,26 @@
 """Win-or-fall-back CI gate: the newest committed bench record must show
-every default-on fused path non-losing (ops/kernel_defaults.py)."""
+every default-on fused path non-losing (ops/kernel_defaults.py).
+
+Record-selection rules (reworked in r5 after the r4 incident — VERDICT
+r4 Weak #1/#2, Next #1):
+
+* **Driver records** (``BENCH_rNN.json``, no suffix) are the authority:
+  the newest parseable one with ``bench_schema >= 2`` supplies the gate
+  values.  Builder-captured records (``BENCH_rNNb_builder.json``) may
+  *supplement* — consulted only when no driver record qualifies — but
+  never substitute for a qualifying driver record.
+* An **unparseable newest driver record is a FAILURE, not a skip**: it
+  means the official perf artifact carries no metrics, which is exactly
+  the r4 incident (bench.py printed a final line too large for the
+  driver's ~2000-char tail capture; ``parsed: null`` landed in-tree).
+  ``BENCH_r04.json`` itself is allowlisted as the diagnosed, fixed
+  instance (bench.py now routes top-ops to a sidecar and size-guards
+  the summary line via ``_emit_record``).
+"""
 import glob
 import json
 import os
+import re
 
 import pytest
 
@@ -11,14 +29,20 @@ from apex_tpu.ops.kernel_defaults import DEFAULT_GATES
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+# The one diagnosed incident: r4's summary line embedded full top-ops
+# tables and defeated the driver's tail parser.  Named here so the gate
+# stays green on the historical artifact while FAILING on any future
+# driver record that comes back unparseable.
+KNOWN_UNPARSEABLE = {"BENCH_r04.json"}
+
+_DRIVER_NAME = re.compile(r"^BENCH_r(\d+)\.json$")
+
 
 def _round_key(path):
     """Natural sort on the round number: BENCH_r10 must sort after
     BENCH_r9 (lexicographic sort would silently enforce a stale record
     from round 10 on).  Suffixed builder records (e.g. r03b_builder)
     sort after the same round's driver record via the string tail."""
-    import re
-
     name = os.path.basename(path)
     m = re.match(r"BENCH_r(\d+)(.*)\.json$", name)
     if not m:
@@ -26,17 +50,41 @@ def _round_key(path):
     return (int(m.group(1)), m.group(2))
 
 
+def _extras(path):
+    """Parsed extras dict of a record, or None if the record carries no
+    parsed metrics (unreadable file, ``parsed: null``, missing extras)."""
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except Exception:
+        return None
+    extras = (rec.get("parsed") or {}).get("extras")
+    return extras if isinstance(extras, dict) else None
+
+
 def _latest_record():
     paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")),
                    key=_round_key)
-    for path in reversed(paths):
-        try:
-            with open(path) as f:
-                rec = json.load(f)
-        except Exception:
+    driver = [p for p in paths if _DRIVER_NAME.match(os.path.basename(p))]
+    if driver:
+        newest = driver[-1]
+        name = os.path.basename(newest)
+        if _extras(newest) is None and name not in KNOWN_UNPARSEABLE:
+            raise AssertionError(
+                f"{name}: the newest DRIVER perf record is unparseable "
+                "(parsed: null / missing extras) — the official artifact "
+                "carries no metrics.  bench.py's summary line must stay "
+                "under the driver's tail-capture size (see _emit_record); "
+                "builder-captured records cannot substitute.")
+    for path in reversed(driver):
+        extras = _extras(path)
+        if extras is not None and extras.get("bench_schema", 0) >= 2:
+            return os.path.basename(path), extras
+    for path in reversed(paths):  # supplement: builder-captured records
+        if path in driver:
             continue
-        extras = rec.get("parsed", {}).get("extras", {})
-        if extras.get("bench_schema", 0) >= 2:
+        extras = _extras(path)
+        if extras is not None and extras.get("bench_schema", 0) >= 2:
             return os.path.basename(path), extras
     return None, None
 
@@ -106,3 +154,90 @@ def test_natural_sort_picks_double_digit_rounds(tmp_path, monkeypatch):
     name, extras = mod._latest_record()
     assert name == "BENCH_r10.json"
     assert extras["xentropy"]["speedup"] == 1.0
+
+
+def test_unparseable_newest_driver_record_fails(tmp_path, monkeypatch):
+    """The r4 incident class: a fresh driver record with parsed:null must
+    FAIL the gate, not silently fall back to self-captured numbers."""
+    import tests.L0.test_kernel_defaults as mod
+
+    good = {"parsed": {"extras": {"bench_schema": 2,
+                                  "xentropy": {"speedup": 1.0}}}}
+    (tmp_path / "BENCH_r06.json").write_text(json.dumps(good))
+    (tmp_path / "BENCH_r07.json").write_text(json.dumps({"parsed": None}))
+    monkeypatch.setattr(mod, "REPO", str(tmp_path))
+    with pytest.raises(AssertionError, match="unparseable"):
+        mod._latest_record()
+
+
+def test_known_bad_r04_falls_back_to_builder(tmp_path, monkeypatch):
+    """BENCH_r04.json (the diagnosed incident) is allowlisted: selection
+    falls through it to the newest parseable schema>=2 record."""
+    import tests.L0.test_kernel_defaults as mod
+
+    builder = {"parsed": {"extras": {"bench_schema": 2,
+                                     "xentropy": {"speedup": 1.0}}}}
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps({"parsed": None}))
+    (tmp_path / "BENCH_r03b_builder.json").write_text(json.dumps(builder))
+    monkeypatch.setattr(mod, "REPO", str(tmp_path))
+    name, extras = mod._latest_record()
+    assert name == "BENCH_r03b_builder.json"
+    assert extras["xentropy"]["speedup"] == 1.0
+
+
+def test_driver_record_outranks_builder_record(tmp_path, monkeypatch):
+    """A qualifying driver record is the authority even when a builder
+    record from the same round sorts after it (closes the r4 loophole
+    where the gate only ever graded self-captured numbers)."""
+    import tests.L0.test_kernel_defaults as mod
+
+    drv = {"parsed": {"extras": {"bench_schema": 2,
+                                 "xentropy": {"speedup": 0.97}}}}
+    bld = {"parsed": {"extras": {"bench_schema": 2,
+                                 "xentropy": {"speedup": 2.0}}}}
+    (tmp_path / "BENCH_r08.json").write_text(json.dumps(drv))
+    (tmp_path / "BENCH_r08b_builder.json").write_text(json.dumps(bld))
+    monkeypatch.setattr(mod, "REPO", str(tmp_path))
+    name, extras = mod._latest_record()
+    assert name == "BENCH_r08.json"
+    assert extras["xentropy"]["speedup"] == 0.97
+
+
+def test_summary_line_always_fits_driver_capture():
+    """bench._emit_record must keep the final stdout line under the
+    driver's tail-capture size no matter how large extras grow, spilling
+    bulk sections to the sidecar (named in spilled_to_sidecar)."""
+    import bench
+
+    huge = [{"name": "fusion.%d" % i, "ms": 1.0, "op": "x" * 120}
+            for i in range(200)]
+    record = {"metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0,
+              "extras": {"bench_schema": 3,
+                         "gpt350m_top_ops": huge,
+                         "layer_norm": {"fwd_speedup": 1.5},
+                         "matmul_roof_tflops": 100.0}}
+    line, spilled = bench._emit_record(record)
+    assert len(line) <= bench.SUMMARY_LINE_LIMIT
+    parsed = json.loads(line)
+    assert "gpt350m_top_ops" in spilled
+    assert "gpt350m_top_ops" in parsed["extras"]["spilled_to_sidecar"]
+    # scalars and small gate sections survive in the line itself
+    assert parsed["extras"]["layer_norm"]["fwd_speedup"] == 1.5
+    assert parsed["extras"]["matmul_roof_tflops"] == 100.0
+
+
+def test_summary_line_fits_even_on_relay_down_run():
+    """A run where every microbench fails leaves only long *_error
+    strings in extras — those must spill too (review finding: strings
+    alone recreated the oversized-line incident)."""
+    import bench
+
+    extras = {"bench_schema": 3}
+    for i in range(12):
+        extras[f"bench_{i}_error"] = "RuntimeError(" + "x" * 200 + ")"
+    record = {"metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0,
+              "extras": extras}
+    line, spilled = bench._emit_record(record)
+    assert len(line) <= bench.SUMMARY_LINE_LIMIT
+    assert json.loads(line)["extras"]["bench_schema"] == 3
+    assert spilled
