@@ -207,15 +207,49 @@ def _make_fwd_kernel(*, scale, causal, block_q, block_k, sq, sk,
     return kernel
 
 
-def _make_fwd_kernel_split(*, scale, causal, block_q, block_k, sq, sk,
+def _merge_parts(parts):
+    """Pairwise tree-merge of local-softmax partial states
+    ``(m_i, l_i, acc_i)`` into one ``(m, l, acc)``.  Log-depth: the merge
+    chain stays short while every tile's two MXU dots remain mutually
+    independent — the scheduler can overlap VPU softmax work of one tile
+    with MXU dots of another (measured: independent d=64 dots run at
+    ~95 TF on v5e vs 47 TF when chained; BASELINE.md r5 notes)."""
+    while len(parts) > 1:
+        nxt = []
+        for a in range(0, len(parts) - 1, 2):
+            m1, l1, acc1 = parts[a]
+            m2, l2, acc2 = parts[a + 1]
+            m = jnp.maximum(m1, m2)
+            a1 = jnp.where(m1 <= _NEG_INF / 2, 0.0, jnp.exp(m1 - m))
+            a2 = jnp.where(m2 <= _NEG_INF / 2, 0.0, jnp.exp(m2 - m))
+            nxt.append((m, a1 * l1 + a2 * l2,
+                        a1[:, None] * acc1 + a2[:, None] * acc2))
+        if len(parts) % 2:
+            nxt.append(parts[-1])
+        parts = nxt
+    return parts[0]
+
+
+def _make_fwd_kernel_tiles(*, scale, causal, block_q, block_k, sq, sk,
                            has_mask, has_seg, dropout_rate):
-    """Split-merge forward: per-k-block LOCAL softmax partials combined
-    once at the end — no serialized rescale chain between k blocks, so
-    the MXU dots of different blocks pipeline independently.  Measured
-    0.524 vs 0.615 ms (+15%) at the GPT-350M shape.  Used when the k
-    extent is at most two blocks; for more blocks the unrolled partials
-    bloat the kernel and the online (carry) form wins."""
-    n_kb = sk // block_k
+    """Fully-unrolled forward: ONE grid step per batch-head; every
+    (q-block, k-block) tile is python-static.
+
+    This generalizes the r4 split-merge kernel (which covered <=2 k
+    blocks) to arbitrary tile counts:
+
+    * causal tiles above the diagonal are skipped AT COMPILE TIME — no
+      wasted MXU work (the online kernel's dynamic trip count, but
+      static);
+    * all visible tiles are mutually independent — no per-k-block
+      rescale carry chain — so Mosaic can pipeline their dots and
+      overlap the VPU softmax of one tile with the MXU dots of another;
+    * per q-block, partial (m, l, acc) states combine by log-depth
+      pairwise tree merge (:func:`_merge_parts`).
+
+    Use is gated by :func:`_tiles_ok` (whole-sequence q/k/v plus live
+    partials must fit VMEM)."""
+    n_qb, n_kb = sq // block_q, sk // block_k
 
     def kernel(*refs):
         it = iter(refs)
@@ -227,48 +261,80 @@ def _make_fwd_kernel_split(*, scale, causal, block_q, block_k, sq, sk,
         o_ref, lse_ref = next(it), next(it)
 
         bh_idx = pl.program_id(0)
-        qi = pl.program_id(1) * block_q
-        q = q_ref[0]
-        seg_q = segq_ref[0, :, 0] if has_seg else None
-
-        parts = []
-        for kb in range(n_kb):
-            ki = kb * block_k
-            k = k_ref[0, pl.ds(ki, block_k), :]
-            v = v_ref[0, pl.ds(ki, block_k), :]
-            s = _assemble_scores(
-                q, k, qi, ki, scale=scale, causal=causal, sq=sq, sk=sk,
-                mask=(mask_ref[0, :, pl.ds(ki, block_k)]
-                      if has_mask else None),
-                seg_q=seg_q,
-                seg_k=(segk_ref[0, pl.ds(ki, block_k), 0]
-                       if has_seg else None))
-            m_i = jnp.max(s, axis=-1)
-            p = _masked_exp(s, m_i[:, None])
-            l_i = jnp.sum(p, axis=-1)
-            if dropout_rate > 0:
-                keep = _dropout_keep(seed_ref[0, 0], bh_idx, qi, ki,
-                                     block_q, block_k, dropout_rate)
-                p = jnp.where(keep, p, 0.0) / (1.0 - dropout_rate)
-            acc_i = jax.lax.dot_general(
-                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            parts.append((m_i, l_i, acc_i))
-
-        m = parts[0][0]
-        for m_i, _, _ in parts[1:]:
-            m = jnp.maximum(m, m_i)
-        l = jnp.zeros_like(m)
-        acc = jnp.zeros_like(parts[0][2])
-        for m_i, l_i, acc_i in parts:
-            a = jnp.where(m_i <= _NEG_INF / 2, 0.0, jnp.exp(m_i - m))
-            l = l + a * l_i
-            acc = acc + a[:, None] * acc_i
-        l_safe = jnp.where(l == 0, 1.0, l)
-        o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = jnp.where(l == 0, _NEG_INF, m + jnp.log(l_safe))[:, None]
+        for qb in range(n_qb):
+            qi = qb * block_q
+            q = q_ref[0, pl.ds(qi, block_q), :]
+            seg_q = segq_ref[0, pl.ds(qi, block_q), 0] if has_seg else None
+            parts = []
+            for kb in range(n_kb):
+                ki = kb * block_k
+                if causal and qi + block_q - 1 + (sk - sq) < ki:
+                    continue  # statically invisible tile
+                k = k_ref[0, pl.ds(ki, block_k), :]
+                v = v_ref[0, pl.ds(ki, block_k), :]
+                s = _assemble_scores(
+                    q, k, qi, ki, scale=scale, causal=causal,
+                    sq=sq, sk=sk,
+                    mask=(mask_ref[0, pl.ds(qi, block_q),
+                                   pl.ds(ki, block_k)]
+                          if has_mask else None),
+                    seg_q=seg_q,
+                    seg_k=(segk_ref[0, pl.ds(ki, block_k), 0]
+                           if has_seg else None))
+                m_i = jnp.max(s, axis=-1)
+                p = _masked_exp(s, m_i[:, None])
+                l_i = jnp.sum(p, axis=-1)
+                if dropout_rate > 0:
+                    keep = _dropout_keep(seed_ref[0, 0], bh_idx, qi, ki,
+                                         block_q, block_k, dropout_rate)
+                    p = jnp.where(keep, p, 0.0) / (1.0 - dropout_rate)
+                acc_i = jax.lax.dot_general(
+                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                parts.append((m_i, l_i, acc_i))
+            if not parts:
+                # causal with sq > sk can statically mask a whole
+                # q-block: its rows attend to nothing — zeros out,
+                # lse = -inf (matching the online kernel's l==0 guard)
+                o_ref[0, pl.ds(qi, block_q), :] = jnp.zeros(
+                    (block_q, q.shape[-1]), o_ref.dtype)
+                lse_ref[0, pl.ds(qi, block_q), :] = jnp.full(
+                    (block_q, 1), _NEG_INF, jnp.float32)
+                continue
+            m, l, acc = _merge_parts(parts)
+            l_safe = jnp.where(l == 0, 1.0, l)
+            o_ref[0, pl.ds(qi, block_q), :] = (
+                acc / l_safe[:, None]).astype(o_ref.dtype)
+            lse_ref[0, pl.ds(qi, block_q), :] = jnp.where(
+                l == 0, _NEG_INF, m + jnp.log(l_safe))[:, None]
 
     return kernel
+
+
+_FWD_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def _tiles_ok(q, k, mask_bias, block_q, block_k, causal):
+    """The unrolled-tiles forward holds whole-sequence q/k/v (and mask)
+    per batch-head plus the live partial states of one q-block row in
+    VMEM; estimate the resident set and refuse when it would not fit
+    (the dispatcher then falls back to the online-carry kernel)."""
+    sq, d = q.shape[1], q.shape[2]
+    sk = k.shape[1]
+    item = q.dtype.itemsize
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    n_kb = sk // bk
+    resident = (
+        2 * sq * d * item          # q stream ×2 pipeline buffers
+        + 2 * 2 * sk * d * item    # k, v streams ×2
+        + 2 * sq * d * item        # o out ×2
+        + 2 * sq * 4               # lse out ×2
+        + n_kb * (bq * d * 4 + 2 * bq * 4)  # partial (acc, m, l) states
+        + 2 * bq * bk * 4          # transient score/p tiles in flight
+    )
+    if mask_bias is not None:
+        resident += 2 * sq * sk * mask_bias.dtype.itemsize
+    return resident <= _FWD_VMEM_BUDGET
 
 
 def _mask_seg_specs(mask_bias, seg_q, seg_k, block_q_spec, sk, gridded_q):
@@ -276,9 +342,29 @@ def _mask_seg_specs(mask_bias, seg_q, seg_k, block_q_spec, sk, gridded_q):
 
     gridded_q: True when grid dim 1 walks q blocks (fwd/dq kernels); False
     when it walks k blocks and the q extent is taken whole (dkv kernel —
-    then ``block_q_spec`` is the full sq and mask/seg_k index by k block).
+    then ``block_q_spec`` is the full sq and mask/seg_k index by k block);
+    None for the unrolled-tiles kernels (grid=(bh,), every operand whole —
+    then ``block_q_spec`` is the full sq).
     """
     specs, args = [], []
+    if gridded_q is None:
+        if mask_bias is not None:
+            # default-arg binding, not closure: see the gridded branches
+            mb1 = mask_bias.shape[0] == 1
+            specs.append(pl.BlockSpec(
+                (1, block_q_spec, sk),
+                lambda b, one=mb1: (0 if one else b, 0, 0)))
+            args.append(mask_bias)
+        if seg_q is not None:
+            sb1 = seg_q.shape[0] == 1
+            specs.append(pl.BlockSpec(
+                (1, block_q_spec, 1),
+                lambda b, one=sb1: (0 if one else b, 0, 0)))
+            specs.append(pl.BlockSpec(
+                (1, sk, 1), lambda b, one=sb1: (0 if one else b, 0, 0)))
+            args.append(seg_q[..., None].astype(jnp.int32))
+            args.append(seg_k[..., None].astype(jnp.int32))
+        return specs, args
     if mask_bias is not None:
         # bind the batch selector as a default arg: a late-binding closure
         # here would silently pick up the *segment* selector below
@@ -332,6 +418,37 @@ def _flash_fwd_pallas(q, k, v, mask_bias, seg_q, seg_k, dropout_seed,
     sk = k.shape[1]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
+    seed_specs, seed_args = _seed_spec_arg(dropout_rate, dropout_seed)
+    kwargs = dict(
+        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        sq=sq, sk=sk, has_mask=mask_bias is not None,
+        has_seg=seg_q is not None, dropout_rate=dropout_rate)
+
+    if _tiles_ok(q, k, mask_bias, block_q, block_k, causal):
+        # unrolled-tiles kernel: one grid step per batch-head, static
+        # causal tile skip, tree merge (no rescale carry chain)
+        in_specs = [
+            pl.BlockSpec((1, sq, d), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0)),
+        ]
+        tail_specs, tail_args = _mask_seg_specs(
+            mask_bias, seg_q, seg_k, sq, sk, gridded_q=None)
+        o, lse = pl.pallas_call(
+            _make_fwd_kernel_tiles(**kwargs),
+            grid=(bh,),
+            in_specs=in_specs + tail_specs + seed_specs,
+            out_specs=[
+                pl.BlockSpec((1, sq, d), lambda b: (b, 0, 0)),
+                pl.BlockSpec((1, sq, 1), lambda b: (b, 0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+            ],
+            interpret=use_interpret(),
+        )(q, k, v, *tail_args, *seed_args)
+        return o, lse[..., 0]
 
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -340,16 +457,9 @@ def _flash_fwd_pallas(q, k, v, mask_bias, seg_q, seg_k, dropout_seed,
     ]
     tail_specs, tail_args = _mask_seg_specs(
         mask_bias, seg_q, seg_k, block_q, sk, gridded_q=True)
-    seed_specs, seed_args = _seed_spec_arg(dropout_rate, dropout_seed)
 
-    make = (_make_fwd_kernel_split if sk // block_k <= 2
-            else _make_fwd_kernel)
-    kernel = make(
-        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-        sq=sq, sk=sk, has_mask=mask_bias is not None,
-        has_seg=seg_q is not None, dropout_rate=dropout_rate)
     o, lse = pl.pallas_call(
-        kernel,
+        _make_fwd_kernel(**kwargs),
         grid=(bh, sq // block_q),
         in_specs=in_specs + tail_specs + seed_specs,
         out_specs=[
@@ -467,6 +577,141 @@ def _make_fused_bwd_kernel(*, scale, causal, block_q, block_k, sq, sk,
     return kernel
 
 
+def _tree_sum(terms):
+    """Pairwise tree-sum: log-depth accumulator chain so the summed
+    tiles' dots stay schedulable in parallel."""
+    while len(terms) > 1:
+        terms = [a + b for a, b in zip(terms[::2], terms[1::2])] + (
+            [terms[-1]] if len(terms) % 2 else [])
+    return terms[0]
+
+
+def _make_bwd_kernel_tiles(*, scale, causal, block_q, block_k, sq, sk,
+                           has_mask, has_seg, dropout_rate):
+    """Fully-unrolled one-pass backward: ONE grid step per batch-head,
+    python-static (q-block, k-block) tiles with compile-time causal
+    skip — the backward counterpart of :func:`_make_fwd_kernel_tiles`.
+
+    Each visible tile recomputes its score block once and feeds all five
+    backward dots; dq/dk/dv partial contributions are combined by
+    log-depth tree-sum instead of a serialized accumulator chain, so the
+    per-tile dot groups (which have no cross-tile dependencies) pipeline
+    on the MXU while another tile's VPU softmax/ds math runs.  Gated by
+    :func:`_bwd_tiles_ok` (whole-sequence streams + live partials must
+    fit VMEM); larger shapes use the grid-scheduled one-pass kernel."""
+    n_qb, n_kb = sq // block_q, sk // block_k
+
+    def visible(qi, ki):
+        return not (causal and qi + block_q - 1 + (sk - sq) < ki)
+
+    def kernel(*refs):
+        it = iter(refs)
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (
+            next(it), next(it), next(it), next(it), next(it), next(it))
+        mask_ref = next(it) if has_mask else None
+        segq_ref = next(it) if has_seg else None
+        segk_ref = next(it) if has_seg else None
+        seed_ref = next(it) if dropout_rate > 0 else None
+        dq_ref, dk_ref, dv_ref = next(it), next(it), next(it)
+
+        bh_idx = pl.program_id(0)
+        dq_parts = [[] for _ in range(n_qb)]
+        for kb in range(n_kb):
+            ki = kb * block_k
+            k = k_ref[0, pl.ds(ki, block_k), :]
+            v = v_ref[0, pl.ds(ki, block_k), :]
+            seg_k = (segk_ref[0, pl.ds(ki, block_k), 0]
+                     if has_seg else None)
+            dk_parts, dv_parts = [], []
+            for qb in range(n_qb):
+                qi = qb * block_q
+                if not visible(qi, ki):
+                    continue
+                q = q_ref[0, pl.ds(qi, block_q), :]
+                do = do_ref[0, pl.ds(qi, block_q), :]
+                lse = lse_ref[0, pl.ds(qi, block_q), 0]
+                delta = delta_ref[0, pl.ds(qi, block_q), 0]
+                s = _assemble_scores(
+                    q, k, qi, ki, scale=scale, causal=causal,
+                    sq=sq, sk=sk,
+                    mask=(mask_ref[0, pl.ds(qi, block_q),
+                                   pl.ds(ki, block_k)]
+                          if has_mask else None),
+                    seg_q=(segq_ref[0, pl.ds(qi, block_q), 0]
+                           if has_seg else None),
+                    seg_k=seg_k)
+                p = _masked_exp(s, lse[:, None])
+                dp = jax.lax.dot_general(
+                    do, v, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                if dropout_rate > 0:
+                    keep = _dropout_keep(seed_ref[0, 0], bh_idx, qi, ki,
+                                         block_q, block_k, dropout_rate)
+                    inv = 1.0 / (1.0 - dropout_rate)
+                    p_drop = jnp.where(keep, p, 0.0) * inv
+                    dp = jnp.where(keep, dp, 0.0) * inv
+                else:
+                    p_drop = p
+                dv_parts.append(jax.lax.dot_general(
+                    p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32))
+                ds = p * (dp - delta[:, None]) * scale
+                dk_parts.append(jax.lax.dot_general(
+                    ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32))
+                dq_parts[qb].append(jax.lax.dot_general(
+                    ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32))
+            d_ = k.shape[-1]
+            if dk_parts:
+                dk_ref[0, pl.ds(ki, block_k), :] = _tree_sum(
+                    dk_parts).astype(dk_ref.dtype)
+                dv_ref[0, pl.ds(ki, block_k), :] = _tree_sum(
+                    dv_parts).astype(dv_ref.dtype)
+            else:  # unreachable for causal sq<=sk; guard for sq>sk edge
+                dk_ref[0, pl.ds(ki, block_k), :] = jnp.zeros(
+                    (block_k, d_), dk_ref.dtype)
+                dv_ref[0, pl.ds(ki, block_k), :] = jnp.zeros(
+                    (block_k, d_), dv_ref.dtype)
+        for qb in range(n_qb):
+            if dq_parts[qb]:
+                dq_ref[0, pl.ds(qb * block_q, block_q), :] = _tree_sum(
+                    dq_parts[qb]).astype(dq_ref.dtype)
+            else:
+                # a statically fully-masked q-block (causal, sq > sk)
+                # contributes no tiles: its dq is zero
+                dq_ref[0, pl.ds(qb * block_q, block_q), :] = jnp.zeros(
+                    (block_q, q_ref.shape[-1]), dq_ref.dtype)
+
+    return kernel
+
+
+def _bwd_tiles_ok(q, k, mask_bias, block_q, block_k, causal):
+    """VMEM estimate for the unrolled-tiles backward: whole-sequence
+    q/k/v/do/lse/delta and dq/dk/dv plus the live dq partials of every
+    q-block and one k-block's dk/dv partials."""
+    if not _pallas_ok(q, k, mask_bias, block_q, block_k):
+        return False
+    sq, d = q.shape[1], q.shape[2]
+    sk = k.shape[1]
+    item = q.dtype.itemsize
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    n_qb, n_kb = sq // bq, sk // bk
+    resident = (
+        2 * 2 * sq * d * item      # q, do streams ×2 buffers
+        + 2 * 2 * sk * d * item    # k, v streams ×2
+        + 2 * 2 * sq * 4           # lse + delta ×2
+        + 2 * sq * d * item        # dq output ×2
+        + 2 * 2 * sk * d * item    # dk/dv outputs ×2
+        + n_kb * sq * d * 4        # dq tile partials, live to final sum
+        + 2 * bk * d * 4           # one k-block's dk/dv partial sums
+        + 3 * bq * bk * 4          # transient score/p/ds tiles in flight
+    )
+    if mask_bias is not None:
+        resident += 2 * sq * sk * mask_bias.dtype.itemsize
+    return resident <= _BWD_VMEM_BUDGET
+
+
 def _flash_bwd_pallas(q, k, v, mask_bias, seg_q, seg_k, dropout_seed,
                       o, lse, do, scale, causal, block_q, block_k,
                       dropout_rate):
@@ -482,6 +727,36 @@ def _flash_bwd_pallas(q, k, v, mask_bias, seg_q, seg_k, dropout_seed,
     has_mask = mask_bias is not None
     has_seg = seg_q is not None
     seed_specs, seed_args = _seed_spec_arg(dropout_rate, dropout_seed)
+    kw = dict(scale=scale, causal=causal, block_q=block_q,
+              block_k=block_k, sq=sq, sk=sk, has_mask=has_mask,
+              has_seg=has_seg, dropout_rate=dropout_rate)
+
+    if _bwd_tiles_ok(q, k, mask_bias, block_q, block_k, causal):
+        in_specs = [pl.BlockSpec((1, sq, d), lambda b: (b, 0, 0)),
+                    pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0)),
+                    pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0)),
+                    pl.BlockSpec((1, sq, d), lambda b: (b, 0, 0)),
+                    pl.BlockSpec((1, sq, 1), lambda b: (b, 0, 0)),
+                    pl.BlockSpec((1, sq, 1), lambda b: (b, 0, 0))]
+        tail_specs, tail_args = _mask_seg_specs(
+            mask_bias, seg_q, seg_k, sq, sk, gridded_q=None)
+        dq, dk, dv = pl.pallas_call(
+            _make_bwd_kernel_tiles(**kw),
+            grid=(bh,),
+            in_specs=in_specs + tail_specs + seed_specs,
+            out_specs=[
+                pl.BlockSpec((1, sq, d), lambda b: (b, 0, 0)),
+                pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0)),
+                pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct(q.shape, q.dtype),
+                jax.ShapeDtypeStruct(k.shape, k.dtype),
+                jax.ShapeDtypeStruct(v.shape, v.dtype),
+            ],
+            interpret=use_interpret(),
+        )(q, k, v, do, lse3, delta, *tail_args, *seed_args)
+        return dq, dk, dv
 
     in_specs = [
         pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),        # q

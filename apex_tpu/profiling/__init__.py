@@ -52,6 +52,7 @@ __all__ = [
 
 from apex_tpu.profiling.trace_report import (  # noqa: E402
     OpTime,
+    device_time_ms,
     format_top_ops,
     parse_trace_dir,
     top_ops_report,

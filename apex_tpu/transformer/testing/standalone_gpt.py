@@ -226,7 +226,17 @@ class ParallelMLP:
         }
 
     def apply(self, params, h):
+        from jax.ad_checkpoint import checkpoint_name
+
         inter = self.dense_h_to_4h.apply(params["dense_h_to_4h"], h)
+        # named for remat_policy="attn_res_mlp": the PRE-gelu h→4h output
+        # is the one tensor whose save removes the layer's biggest GEMM
+        # (4h² of the 12h² per-layer GEMM flops) from the remat
+        # recompute — gelu's backward needs this value, gelu/4h→h-wgrad
+        # inputs rebuild from it elementwise, and the 4h→h forward
+        # output is dead in the recompute graph (nothing in the backward
+        # reads it)
+        inter = checkpoint_name(inter, "mlp_4h")
         inter = jax.nn.gelu(inter, approximate=True)  # bias_gelu fusion (:250)
         return self.dense_4h_to_h.apply(params["dense_4h_to_h"], inter)
 
@@ -385,6 +395,16 @@ class ParallelTransformer:
                 # to rebuild them)
                 policy = jax.checkpoint_policies.save_only_these_names(
                     "flash_attn_out", "flash_attn_lse")
+            elif self.cfg.remat_policy == "attn_res_mlp":
+                # attn_res plus the pre-gelu h→4h output (named in
+                # ParallelMLP.apply): with both saved, no GEMM runs in
+                # the recompute at all — qkv/proj wgrads read the saved
+                # o residual and cheap LN recomputes, the mlp wgrads
+                # read mlp_4h and its elementwise gelu.  Costs
+                # +b·s·4h·2B per layer over attn_res (64 MB at the
+                # 350M bench shape)
+                policy = jax.checkpoint_policies.save_only_these_names(
+                    "flash_attn_out", "flash_attn_lse", "mlp_4h")
             elif self.cfg.remat_policy == "attn_out":
                 # keep the flash-attention output per layer (named above):
                 # +16 MB/layer at the 350M shape.  This only removes
@@ -396,8 +416,14 @@ class ParallelTransformer:
                 # Measured ~7% off the step at B=8 (BASELINE.md r4 sweep)
                 policy = jax.checkpoint_policies.save_only_these_names(
                     "attn_out")
-            else:
+            elif self.cfg.remat_policy == "full":
                 policy = None
+            else:
+                # a misspelled policy must not silently degrade to full
+                # recompute (review finding)
+                raise ValueError(
+                    f"unknown remat_policy {self.cfg.remat_policy!r}; "
+                    "expected full|dots|attn_res|attn_res_mlp|attn_out")
             body = jax.checkpoint(body, policy=policy)
         (h, aux), _ = jax.lax.scan(
             body, (h, jnp.zeros((), jnp.float32)),

@@ -299,19 +299,24 @@ GPT_L, GPT_H, GPT_V, GPT_SEQ = 24, 1024, 51200, 1024
 
 
 def gpt_analytic_flops(n_tokens, batch, *, with_remat=False,
-                       remat_attn=True):
+                       remat_attn=True, remat_mlp=True):
     """Analytic fwd+bwd matmul flops for the 350M GPT (causal attention
     counted at half density).  ``with_remat`` adds the transformer-body
     forward recompute that per-layer remat performs — the *hardware*
     flops, vs the model flops used for MFU; ``remat_attn=False``
-    (the "attn_res" policy) excludes the attention from the recompute."""
+    (the "attn_res" policies) excludes the attention from the recompute;
+    ``remat_mlp=False`` ("attn_res_mlp") additionally excludes the
+    h→4h GEMM (the saved mlp_4h tensor, 4h² of the 12h² body GEMMs)."""
     body = 2 * 12 * GPT_H * GPT_H * GPT_L * n_tokens
     attn = 2 * 2 * batch * GPT_SEQ * GPT_SEQ * GPT_H * GPT_L / 2
     logits = 2 * n_tokens * GPT_H * GPT_V
     fwd = body + attn + logits
     total = 3 * fwd
     if with_remat:
-        total += body + (attn if remat_attn else 0)
+        recompute = body + (attn if remat_attn else 0)
+        if not remat_mlp:
+            recompute -= 2 * 4 * GPT_H * GPT_H * GPT_L * n_tokens
+        total += recompute
     return total
 
 
@@ -390,6 +395,25 @@ def bench_gpt350m():
                                                  labels)
         final = float(loss)
         best_dt = min(best_dt, (time.perf_counter() - t0) / steps)
+    # device-clock step time as well: the relay adds a host dispatch gap
+    # that wall-clock includes (measured 210 ms wall vs 181 ms device at
+    # r5; under relay contention wall degrades arbitrarily — 1.3 s/step
+    # observed — while device time holds), so the record carries both
+    device_dt = None
+    try:
+        state = {"p": params, "o": opt_state}
+
+        def stepfn(t, l):
+            state["p"], state["o"], loss = train_step(state["p"],
+                                                      state["o"], t, l)
+            return loss
+
+        float(stepfn(tokens, labels))
+        device_dt = profiling.device_time_ms(stepfn, tokens, labels,
+                                             steps=2) / 1e3
+        params, opt_state = state["p"], state["o"]
+    except Exception:
+        pass
     # top-ops capture lives in a SUBPROCESS (main() calls
     # _topops_subprocess) so a poisoned capture cannot lose the record
     parallel_state.destroy_model_parallel()
@@ -404,11 +428,14 @@ def bench_gpt350m():
     # is elementwise-only (zero matmul flops)
     hw_fl = gpt_analytic_flops(
         n_tok, B,
-        with_remat=(remat_policy in ("full", "attn_out", "attn_res")),
-        remat_attn=(remat_policy != "attn_res"))
+        with_remat=(remat_policy in ("full", "attn_out", "attn_res",
+                                     "attn_res_mlp")),
+        remat_attn=(remat_policy not in ("attn_res", "attn_res_mlp")),
+        remat_mlp=(remat_policy != "attn_res_mlp"))
     return (n_tok / best_dt, model_fl / best_dt / 1e12,
             hw_fl / best_dt / 1e12, cost_flops / best_dt / 1e12,
-            remat_policy, None)
+            remat_policy, device_dt,
+            (model_fl / device_dt / 1e12 if device_dt else None))
 
 
 # ---------------------------------------------------------------------------
@@ -479,42 +506,54 @@ def bench_attention_kernel(bh, s, d, block_q, block_k, measure_floor=False):
 
 def _attention_dot_floor(bh, s, d, block_q, block_k):
     """TFLOPS of a kernel doing ONLY the two attention matmuls (no
-    softmax, same tiling, causal trip skip) — the MXU ceiling the fwd
-    kernel is measured against.  The bwd ceiling is 2.5x this work."""
+    softmax) — the MXU ceiling the fwd kernel is measured against.  The
+    bwd ceiling is 2.5x this work.
+
+    r5: restructured to the same static-tile ILP form as the production
+    forward (one grid step per batch-head, python-unrolled tiles with
+    compile-time causal skip).  The r4 floor (46.9 TF at d=64) was an
+    artifact of the old serialized per-k-block carry loop: independent
+    d=64 dots measure ~95 TF on v5e (BASELINE.md r5 MXU notes), so a
+    serial-chain floor flattered the fwd kernel's fraction-of-floor."""
     from jax.experimental import pallas as pl
 
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     q, k, v = (jax.random.normal(kk, (bh, s, d), jnp.bfloat16) for kk in ks)
     bq, bk = min(block_q, s), min(block_k, s)
+    n_qb, n_kb = s // bq, s // bk
 
     def kernel(q_ref, k_ref, v_ref, o_ref):
-        qi = pl.program_id(1) * bq
-        qq = q_ref[0]
-        n_kb = jnp.minimum(s // bk, (qi + bq - 1) // bk + 1)
-
-        def body(kb, acc):
-            kk = k_ref[0, pl.ds(kb * bk, bk), :]
-            vv = v_ref[0, pl.ds(kb * bk, bk), :]
-            sc = jax.lax.dot_general(qq, kk, (((1,), (1,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
-            return acc + jax.lax.dot_general(
-                (sc * 1e-3).astype(vv.dtype), vv, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-
-        acc = jax.lax.fori_loop(0, n_kb, body,
-                                jnp.zeros((bq, d), jnp.float32))
-        o_ref[0] = acc.astype(o_ref.dtype)
+        for qb in range(n_qb):
+            qi = qb * bq
+            qq = q_ref[0, pl.ds(qi, bq), :]
+            accs = []
+            for kb in range(n_kb):
+                if qi + bq - 1 < kb * bk:
+                    continue  # static causal tile skip
+                kk = k_ref[0, pl.ds(kb * bk, bk), :]
+                vv = v_ref[0, pl.ds(kb * bk, bk), :]
+                sc = jax.lax.dot_general(
+                    qq, kk, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                accs.append(jax.lax.dot_general(
+                    (sc * 1e-3).astype(vv.dtype), vv,
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32))
+            while len(accs) > 1:
+                accs = [a + b for a, b in zip(accs[::2], accs[1::2])] + (
+                    [accs[-1]] if len(accs) % 2 else [])
+            o_ref[0, pl.ds(qi, bq), :] = accs[0].astype(o_ref.dtype)
 
     def run(q, k, v):
         return pl.pallas_call(
             kernel,
-            grid=(bh, s // bq),
+            grid=(bh,),
             in_specs=[
-                pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, s, d), lambda b: (b, 0, 0)),
+                pl.BlockSpec((1, s, d), lambda b: (b, 0, 0)),
+                pl.BlockSpec((1, s, d), lambda b: (b, 0, 0)),
             ],
-            out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            out_specs=pl.BlockSpec((1, s, d), lambda b: (b, 0, 0)),
             out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         )(q, k, v)
 
@@ -857,7 +896,8 @@ def main():
     if not FAST:
         gpt = attempt("gpt350m", bench_gpt350m)
         if gpt is not None:
-            tok_s, model_tf, hw_tf, cost_tf, policy, _ = gpt
+            (tok_s, model_tf, hw_tf, cost_tf, policy, device_dt,
+             device_tf) = gpt
             extras["gpt350m_tokens_per_sec"] = round(tok_s, 0)
             extras["gpt350m_model_tflops"] = round(model_tf, 1)
             extras["gpt350m_hw_tflops"] = round(hw_tf, 1)
@@ -865,6 +905,14 @@ def main():
             extras["gpt350m_remat_policy"] = policy
             if roof is not None:
                 extras["gpt350m_mfu_vs_roof"] = round(model_tf / roof, 3)
+            if device_dt is not None:
+                # device-clock step time: excludes the relay's host
+                # dispatch gap (BASELINE.md r5 wall-vs-device note)
+                extras["gpt350m_device_ms_per_step"] = round(
+                    device_dt * 1e3, 1)
+                if roof is not None and device_tf is not None:
+                    extras["gpt350m_mfu_device"] = round(
+                        device_tf / roof, 3)
 
     sidecar = {}
     if not FAST:

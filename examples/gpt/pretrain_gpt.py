@@ -55,7 +55,8 @@ def _extra_args(parser):
     # Megatron argument clone (arguments.py); only add what it lacks
     g = parser.add_argument_group("pretrain_gpt")
     g.add_argument("--remat-policy", default="attn_res",
-                   choices=["full", "dots", "attn_res", "attn_out"])
+                   choices=["full", "dots", "attn_res", "attn_res_mlp",
+                            "attn_out"])
     g.add_argument("--vocab-size", type=int, default=51200,
                    help="unpadded vocab; padded to "
                         "--make-vocab-size-divisible-by x tp")

@@ -46,6 +46,26 @@ class TestFlashAttention:
             flash_attention(q, k, v, causal=True), _naive(q, k, v, True),
             rtol=1e-4, atol=1e-5)
 
+    def test_causal_sq_longer_than_sk(self):
+        # causal cross-attention with sq > sk: the leading q rows attend
+        # to nothing (fully masked) — the unrolled-tiles kernels must
+        # emit zeros for statically-invisible q-blocks, not crash
+        # (r5 review finding)
+        q = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 16))
+        k = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 16))
+        v = jax.random.normal(jax.random.PRNGKey(2), (2, 32, 16))
+        out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+        ref = _naive(q, k, v, causal=True)
+        # rows whose causal window is empty are zero by flash convention
+        empty = jnp.arange(64) + (32 - 64) < 0
+        ref = jnp.where(empty[None, :, None], 0.0, ref)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+        g = jax.grad(lambda *a: jnp.sum(flash_attention(
+            *a, causal=True, block_q=16, block_k=16) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for t in g:
+            assert np.isfinite(np.asarray(t)).all()
+
     def test_4d_and_cross_lengths(self):
         q = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 16, 8))
         k = jax.random.normal(jax.random.PRNGKey(1), (2, 4, 32, 8))
