@@ -314,7 +314,7 @@ def _make_fwd_kernel_tiles(*, scale, causal, block_q, block_k, sq, sk,
 _FWD_VMEM_BUDGET = 12 * 1024 * 1024
 
 
-def _tiles_ok(q, k, mask_bias, block_q, block_k, causal):
+def _tiles_ok(q, k, mask_bias, block_q, block_k):
     """The unrolled-tiles forward holds whole-sequence q/k/v (and mask)
     per batch-head plus the live partial states of one q-block row in
     VMEM; estimate the resident set and refuse when it would not fit
@@ -424,7 +424,7 @@ def _flash_fwd_pallas(q, k, v, mask_bias, seg_q, seg_k, dropout_seed,
         sq=sq, sk=sk, has_mask=mask_bias is not None,
         has_seg=seg_q is not None, dropout_rate=dropout_rate)
 
-    if _tiles_ok(q, k, mask_bias, block_q, block_k, causal):
+    if _tiles_ok(q, k, mask_bias, block_q, block_k):
         # unrolled-tiles kernel: one grid step per batch-head, static
         # causal tile skip, tree merge (no rescale carry chain)
         in_specs = [
@@ -686,7 +686,7 @@ def _make_bwd_kernel_tiles(*, scale, causal, block_q, block_k, sq, sk,
     return kernel
 
 
-def _bwd_tiles_ok(q, k, mask_bias, block_q, block_k, causal):
+def _bwd_tiles_ok(q, k, mask_bias, block_q, block_k):
     """VMEM estimate for the unrolled-tiles backward: whole-sequence
     q/k/v/do/lse/delta and dq/dk/dv plus the live dq partials of every
     q-block and one k-block's dk/dv partials."""
@@ -731,7 +731,7 @@ def _flash_bwd_pallas(q, k, v, mask_bias, seg_q, seg_k, dropout_seed,
               block_k=block_k, sq=sq, sk=sk, has_mask=has_mask,
               has_seg=has_seg, dropout_rate=dropout_rate)
 
-    if _bwd_tiles_ok(q, k, mask_bias, block_q, block_k, causal):
+    if _bwd_tiles_ok(q, k, mask_bias, block_q, block_k):
         in_specs = [pl.BlockSpec((1, sq, d), lambda b: (b, 0, 0)),
                     pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0)),
                     pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0)),

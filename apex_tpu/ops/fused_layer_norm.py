@@ -67,6 +67,11 @@ def _ln_fwd_kernel(x_ref, w_ref, b_ref, y_ref, mean_ref, invvar_ref, *, eps, n_c
 def _pallas_ln_fwd(x2d, weight, bias, eps):
     rows, cols = x2d.shape
     block_rows = max(1, min(rows, 2048 * LANE // max(cols, LANE)))
+    if rows >= 8:
+        # Mosaic sublane grain: the row-block must be a multiple of 8
+        # (or equal to the full row extent) — wide cols drove the raw
+        # quotient below 8 and failed lowering (r5 fix)
+        block_rows = max(8, block_rows // 8 * 8)
     grid = (rows + block_rows - 1) // block_rows
     has_w, has_b = weight is not None, bias is not None
 
@@ -119,6 +124,109 @@ def _xla_ln_fwd(x2d, weight, bias, eps):
 
 
 # ---------------------------------------------------------------------------
+# Pallas backward kernel: one pass dx + two-stage dgamma/dbeta
+# (the reference backward architecture, csrc/layer_norm_cuda_kernel.cu:791 —
+# cuda_layer_norm_gradient's part1/part2 partial reductions).  Stage 1 is a
+# Pallas grid over row blocks emitting dx and per-block [1, cols] dgamma/
+# dbeta partials; stage 2 sums the [n_blocks, cols] partials (tiny, XLA).
+# Added in r5: the XLA one-pass backward measured 0.66x of the HBM roof at
+# the bench shape (VERDICT r4 Next #5) — it re-reads x for the reductions;
+# this kernel touches x/dy once.
+# ---------------------------------------------------------------------------
+
+
+def _ln_bwd_block_rows(rows, cols):
+    """Row-block size keeping x/dy/dx blocks (double-buffered) plus fp32
+    temporaries within a conservative VMEM budget; multiple of the
+    8-row sublane grain (or the full row extent)."""
+    bm = max(8, min(rows, (1 << 19) // max(cols, LANE)))
+    return min(rows, bm // 8 * 8) if rows >= 8 else rows
+
+
+def _pallas_ln_bwd(x2d, dy, mean, invvar, weight, has_w, has_b):
+    rows, cols = x2d.shape
+    bm = _ln_bwd_block_rows(rows, cols)
+    grid = (rows + bm - 1) // bm
+
+    def kernel(*refs):
+        it = iter(refs)
+        x_ref, dy_ref, mean_ref, invvar_ref = (
+            next(it), next(it), next(it), next(it))
+        w_ref = next(it) if has_w else None
+        dx_ref = next(it)
+        dwp_ref = next(it) if has_w else None
+        dbp_ref = next(it) if has_b else None
+
+        i = pl.program_id(0)
+        x = x_ref[...].astype(jnp.float32)
+        g = dy_ref[...].astype(jnp.float32)
+        # ragged last block: Pallas pads reads — rows beyond the array
+        # must not contribute to the dgamma/dbeta partial sums
+        valid = (i * bm + jax.lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
+                 ) < rows
+        g = jnp.where(valid, g, 0.0)
+        # mask xhat as well: padded rows carry garbage stats, and
+        # 0 * inf would poison the dgamma partial with NaN
+        xhat = jnp.where(valid, (x - mean_ref[...]) * invvar_ref[...], 0.0)
+        gw = (g * w_ref[0].astype(jnp.float32)[None, :]
+              if has_w else g)
+        c1 = jnp.mean(gw, axis=-1, keepdims=True)
+        c2 = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+        dx_ref[...] = ((gw - c1 - xhat * c2)
+                       * invvar_ref[...]).astype(dx_ref.dtype)
+        # dgamma/dbeta accumulate into an [8, cols] VMEM-resident buffer
+        # (constant index_map keeps it on-chip across the sequential
+        # grid; slot i%8 spreads the serial add chains 8-ways).  This is
+        # the reference's part1/part2 two-stage reduction collapsed into
+        # one kernel by the TPU grid's sequential execution; the final
+        # 8-row sum happens outside.
+        @pl.when(i == 0)
+        def _():
+            if has_w:
+                dwp_ref[...] = jnp.zeros_like(dwp_ref)
+            if has_b:
+                dbp_ref[...] = jnp.zeros_like(dbp_ref)
+        slot = i % 8
+        if has_w:
+            dwp_ref[pl.ds(slot, 1), :] += jnp.sum(g * xhat, axis=0,
+                                                  keepdims=True)
+        if has_b:
+            dbp_ref[pl.ds(slot, 1), :] += jnp.sum(g, axis=0,
+                                                  keepdims=True)
+
+    in_specs = [
+        pl.BlockSpec((bm, cols), lambda i: (i, 0)),
+        pl.BlockSpec((bm, cols), lambda i: (i, 0)),
+        pl.BlockSpec((bm, 1), lambda i: (i, 0)),
+        pl.BlockSpec((bm, 1), lambda i: (i, 0)),
+    ]
+    args = [x2d, dy, mean[:, None], invvar[:, None]]
+    if has_w:
+        in_specs.append(pl.BlockSpec((1, cols), lambda i: (0, 0)))
+        args.append(weight[None, :])
+    out_specs = [pl.BlockSpec((bm, cols), lambda i: (i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((rows, cols), x2d.dtype)]
+    for flag in (has_w, has_b):
+        if flag:
+            out_specs.append(pl.BlockSpec((8, cols), lambda i: (0, 0)))
+            out_shape.append(
+                jax.ShapeDtypeStruct((8, cols), jnp.float32))
+    outs = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=use_interpret(),
+    )(*args)
+    outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+    dx = outs.pop(0)
+    dw = jnp.sum(outs.pop(0), axis=0) if has_w else None
+    db = jnp.sum(outs.pop(0), axis=0) if has_b else None
+    return dx, dw, db
+
+
+# ---------------------------------------------------------------------------
 # custom_vjp wrapper
 # ---------------------------------------------------------------------------
 
@@ -140,6 +248,16 @@ def _layer_norm_bwd(eps, use_pallas, res, dy):
     # Fused dgrad+dgamma+dbeta, the cuda_layer_norm_gradient contract
     # (csrc/layer_norm_cuda_kernel.cu:791): everything in fp32, one pass.
     x2d, weight, bias, mean, invvar = res
+    # width gate: at the bm=8 floor, very wide rows blow the VMEM budget
+    # (double-buffered 8xcols blocks + the resident [8, cols] fp32
+    # partial buffers) — fall back to the XLA backward there
+    if (use_pallas and x2d.shape[1] % LANE == 0
+            and x2d.shape[1] <= (1 << 15)):
+        dx, dw, db = _pallas_ln_bwd(x2d, dy, mean, invvar, weight,
+                                    weight is not None, bias is not None)
+        return (dx,
+                dw.astype(weight.dtype) if weight is not None else None,
+                db.astype(bias.dtype) if bias is not None else None)
     x = x2d.astype(jnp.float32)
     g = dy.astype(jnp.float32)
     xhat = (x - mean[:, None]) * invvar[:, None]

@@ -158,7 +158,18 @@ def top_ops_report(fn: Callable, *args, steps: int = 3,
     owndir = logdir is None
     logdir = logdir or tempfile.mkdtemp(prefix="apex_tpu_prof_")
     try:
-        jax.profiler.start_trace(logdir)
+        # host tracer OFF: the relay's host activity can emit >1M events
+        # per step, and the trace writer caps at ~1M events TOTAL — a
+        # host-spammed window evicts the entire device timeline and the
+        # parse silently returns zero ops (observed r5).  Only device
+        # events are consumed here.
+        try:
+            opts = jax.profiler.ProfileOptions()
+            opts.host_tracer_level = 0
+            opts.python_tracer_level = 0
+            jax.profiler.start_trace(logdir, profiler_options=opts)
+        except (AttributeError, TypeError):  # older jax: no options
+            jax.profiler.start_trace(logdir)
         try:
             out = None
             for _ in range(steps):

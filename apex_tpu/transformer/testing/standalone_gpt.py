@@ -397,12 +397,16 @@ class ParallelTransformer:
                     "flash_attn_out", "flash_attn_lse")
             elif self.cfg.remat_policy == "attn_res_mlp":
                 # attn_res plus the pre-gelu h→4h output (named in
-                # ParallelMLP.apply): with both saved, no GEMM runs in
-                # the recompute at all — qkv/proj wgrads read the saved
-                # o residual and cheap LN recomputes, the mlp wgrads
-                # read mlp_4h and its elementwise gelu.  Costs
+                # ParallelMLP.apply): removes the h→4h GEMM (the
+                # largest single recompute GEMM, 4h² of the 12h² body)
+                # and gelu from the recompute.  The qkv and proj GEMMs
+                # STILL recompute — the flash custom_vjp saves only
+                # (o, lse), and its backward consumes q/k/v, which must
+                # be rebuilt (bench.py's gpt_analytic_flops keeps their
+                # 4h² in the recompute term accordingly).  Costs
                 # +b·s·4h·2B per layer over attn_res (64 MB at the
-                # 350M bench shape)
+                # 350M bench shape); measured LOSING to attn_res at
+                # B=8/16 (BASELINE.md r5 sweep)
                 policy = jax.checkpoint_policies.save_only_these_names(
                     "flash_attn_out", "flash_attn_lse", "mlp_4h")
             elif self.cfg.remat_policy == "attn_out":

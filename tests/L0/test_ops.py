@@ -68,6 +68,27 @@ class TestFusedLayerNorm:
             rtol=1e-6, atol=1e-6,
         )
 
+    def test_pallas_bwd_interpret_matches_xla(self):
+        # rows=200 with cols=4096 gives block_rows=128 -> a ragged last
+        # block, exercising the stage-1 partial-sum row masking of the
+        # r5 Pallas backward (dx + two-stage dgamma/dbeta)
+        x = jax.random.normal(jax.random.PRNGKey(5), (200, 4096),
+                              jnp.float32)
+        r = jax.random.normal(jax.random.PRNGKey(6), (200, 4096),
+                              jnp.float32)
+        w = jnp.full((4096,), 1.1)
+        b = jnp.full((4096,), -0.2)
+
+        def loss(up):
+            def f(x, w, b):
+                return jnp.sum(ops.layer_norm(x, w, b, use_pallas=up) * r)
+            return f
+
+        g1 = jax.grad(loss(True), argnums=(0, 1, 2))(x, w, b)
+        g2 = jax.grad(loss(False), argnums=(0, 1, 2))(x, w, b)
+        for a, e in zip(g1, g2):
+            np.testing.assert_allclose(a, e, rtol=1e-4, atol=1e-3)
+
     def test_bf16_output_dtype_follows_input(self):
         x = jax.random.normal(jax.random.PRNGKey(0), (4, 128)).astype(jnp.bfloat16)
         w = jnp.ones((128,), jnp.float32)
