@@ -202,7 +202,10 @@ def _make_fwd_kernel(*, scale, causal, block_q, block_k, sq, sk,
         m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
         l_safe = jnp.where(l == 0, 1.0, l)
         o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = jnp.where(l == 0, _NEG_INF, m + jnp.log(l_safe))[:, None]
+        # dense [8, bq] row-broadcast lse block (see the tiles kernel's
+        # layout note — a trailing-singleton output tiles at 128x cost)
+        lse_row = jnp.where(l == 0, _NEG_INF, m + jnp.log(l_safe))
+        lse_ref[0, 0] = jnp.broadcast_to(lse_row[None, :], (8, block_q))
 
     return kernel
 
@@ -298,15 +301,20 @@ def _make_fwd_kernel_tiles(*, scale, causal, block_q, block_k, sq, sk,
                 # lse = -inf (matching the online kernel's l==0 guard)
                 o_ref[0, pl.ds(qi, block_q), :] = jnp.zeros(
                     (block_q, q.shape[-1]), o_ref.dtype)
-                lse_ref[0, pl.ds(qi, block_q), :] = jnp.full(
-                    (block_q, 1), _NEG_INF, jnp.float32)
+                lse_ref[0, qb] = jnp.full((8, block_q), _NEG_INF,
+                                          jnp.float32)
                 continue
             m, l, acc = _merge_parts(parts)
             l_safe = jnp.where(l == 0, 1.0, l)
             o_ref[0, pl.ds(qi, block_q), :] = (
                 acc / l_safe[:, None]).astype(o_ref.dtype)
-            lse_ref[0, pl.ds(qi, block_q), :] = jnp.where(
-                l == 0, _NEG_INF, m + jnp.log(l_safe))[:, None]
+            # lse goes to a DENSE [n_qb, 8, bq] arrangement (row-
+            # broadcast): a [sq, 1] trailing-singleton output would get
+            # the (8,128)-tile layout with 128x physical amplification —
+            # measured as multi-ms "broadcast" copies in the GPT step
+            lse_row = jnp.where(l == 0, _NEG_INF, m + jnp.log(l_safe))
+            lse_ref[0, qb] = jnp.broadcast_to(lse_row[None, :],
+                                              (8, block_q))
 
     return kernel
 
@@ -328,7 +336,7 @@ def _tiles_ok(q, k, mask_bias, block_q, block_k):
         2 * sq * d * item          # q stream ×2 pipeline buffers
         + 2 * 2 * sk * d * item    # k, v streams ×2
         + 2 * sq * d * item        # o out ×2
-        + 2 * sq * 4               # lse out ×2
+        + 2 * 8 * sq * 4           # lse out (dense [n_qb,8,bq] rows) ×2
         + n_kb * (bq * d * 4 + 2 * bq * 4)  # partial (acc, m, l) states
         + 2 * bq * bk * 4          # transient score/p tiles in flight
     )
@@ -434,21 +442,24 @@ def _flash_fwd_pallas(q, k, v, mask_bias, seg_q, seg_k, dropout_seed,
         ]
         tail_specs, tail_args = _mask_seg_specs(
             mask_bias, seg_q, seg_k, sq, sk, gridded_q=None)
+        n_qb = sq // block_q
         o, lse = pl.pallas_call(
             _make_fwd_kernel_tiles(**kwargs),
             grid=(bh,),
             in_specs=in_specs + tail_specs + seed_specs,
             out_specs=[
                 pl.BlockSpec((1, sq, d), lambda b: (b, 0, 0)),
-                pl.BlockSpec((1, sq, 1), lambda b: (b, 0, 0)),
+                pl.BlockSpec((1, n_qb, 8, block_q),
+                             lambda b: (b, 0, 0, 0)),
             ],
             out_shape=[
                 jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-                jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+                jax.ShapeDtypeStruct((bh, n_qb, 8, block_q),
+                                     jnp.float32),
             ],
             interpret=use_interpret(),
         )(q, k, v, *tail_args, *seed_args)
-        return o, lse[..., 0]
+        return o, lse[:, :, 0, :].reshape(bh, sq)
 
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -458,23 +469,22 @@ def _flash_fwd_pallas(q, k, v, mask_bias, seg_q, seg_k, dropout_seed,
     tail_specs, tail_args = _mask_seg_specs(
         mask_bias, seg_q, seg_k, block_q, sk, gridded_q=True)
 
+    n_qb = sq // block_q
     o, lse = pl.pallas_call(
         _make_fwd_kernel(**kwargs),
-        grid=(bh, sq // block_q),
+        grid=(bh, n_qb),
         in_specs=in_specs + tail_specs + seed_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            # lse carries a trailing singleton lane dim to satisfy the TPU
-            # (sublane, lane) block tiling rules
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, 8, block_q), lambda b, i: (b, i, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, n_qb, 8, block_q), jnp.float32),
         ],
         interpret=use_interpret(),
     )(q, k, v, *tail_args, *seed_args)
-    return o, lse[..., 0]
+    return o, lse[:, :, 0, :].reshape(bh, sq)
 
 
 # ---------------------------------------------------------------------------
@@ -606,7 +616,7 @@ def _make_bwd_kernel_tiles(*, scale, causal, block_q, block_k, sq, sk,
 
     def kernel(*refs):
         it = iter(refs)
-        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (
+        q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref = (
             next(it), next(it), next(it), next(it), next(it), next(it))
         mask_ref = next(it) if has_mask else None
         segq_ref = next(it) if has_seg else None
@@ -615,6 +625,18 @@ def _make_bwd_kernel_tiles(*, scale, causal, block_q, block_k, sq, sk,
         dq_ref, dk_ref, dv_ref = next(it), next(it), next(it)
 
         bh_idx = pl.program_id(0)
+        # delta = rowsum(do * o), computed IN-KERNEL per q-block from
+        # the saved o: passing it as a [bh, sq, 1] operand (like lse
+        # used to be) forces a trailing-singleton layout whose (8,128)
+        # tiling amplifies it 128x physically — measured as multi-ms
+        # copies in the GPT step.  lse arrives as a dense [1, sq] lane
+        # row instead, statically sliced per q-block.
+        deltas = [
+            jnp.sum(do_ref[0, pl.ds(qb * block_q, block_q), :].astype(
+                jnp.float32)
+                * o_ref[0, pl.ds(qb * block_q, block_q), :].astype(
+                    jnp.float32), axis=-1)
+            for qb in range(n_qb)]
         dq_parts = [[] for _ in range(n_qb)]
         for kb in range(n_kb):
             ki = kb * block_k
@@ -629,8 +651,8 @@ def _make_bwd_kernel_tiles(*, scale, causal, block_q, block_k, sq, sk,
                     continue
                 q = q_ref[0, pl.ds(qi, block_q), :]
                 do = do_ref[0, pl.ds(qi, block_q), :]
-                lse = lse_ref[0, pl.ds(qi, block_q), 0]
-                delta = delta_ref[0, pl.ds(qi, block_q), 0]
+                lse = lse_ref[0, 0, qi:qi + block_q]
+                delta = deltas[qb]
                 s = _assemble_scores(
                     q, k, qi, ki, scale=scale, causal=causal,
                     sq=sq, sk=sk,
@@ -698,9 +720,10 @@ def _bwd_tiles_ok(q, k, mask_bias, block_q, block_k):
     bq, bk = min(block_q, sq), min(block_k, sk)
     n_qb, n_kb = sq // bq, sk // bk
     resident = (
-        2 * 2 * sq * d * item      # q, do streams ×2 buffers
+        2 * 3 * sq * d * item      # q, do, o streams ×2 buffers
         + 2 * 2 * sk * d * item    # k, v streams ×2
-        + 2 * 2 * sq * 4           # lse + delta ×2
+        + 2 * 8 * sq * 4           # lse lane-row ([1, sq], 8x tiling) ×2
+        + sq * 4                   # in-kernel delta rows
         + 2 * sq * d * item        # dq output ×2
         + 2 * 2 * sk * d * item    # dk/dv outputs ×2
         + n_kb * sq * d * 4        # dq tile partials, live to final sum
@@ -721,9 +744,6 @@ def _flash_bwd_pallas(q, k, v, mask_bias, seg_q, seg_k, dropout_seed,
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     n_qb, n_kb = sq // block_q, sk // block_k
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1, keepdims=True)  # [bh, sq, 1]
-    lse3 = lse[..., None]
     has_mask = mask_bias is not None
     has_seg = seg_q is not None
     seed_specs, seed_args = _seed_spec_arg(dropout_rate, dropout_seed)
@@ -736,8 +756,8 @@ def _flash_bwd_pallas(q, k, v, mask_bias, seg_q, seg_k, dropout_seed,
                     pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0)),
                     pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0)),
                     pl.BlockSpec((1, sq, d), lambda b: (b, 0, 0)),
-                    pl.BlockSpec((1, sq, 1), lambda b: (b, 0, 0)),
-                    pl.BlockSpec((1, sq, 1), lambda b: (b, 0, 0))]
+                    pl.BlockSpec((1, 1, sq), lambda b: (b, 0, 0)),
+                    pl.BlockSpec((1, sq, d), lambda b: (b, 0, 0))]
         tail_specs, tail_args = _mask_seg_specs(
             mask_bias, seg_q, seg_k, sq, sk, gridded_q=None)
         dq, dk, dv = pl.pallas_call(
@@ -755,9 +775,15 @@ def _flash_bwd_pallas(q, k, v, mask_bias, seg_q, seg_k, dropout_seed,
                 jax.ShapeDtypeStruct(v.shape, v.dtype),
             ],
             interpret=use_interpret(),
-        )(q, k, v, do, lse3, delta, *tail_args, *seed_args)
+        )(q, k, v, do, lse[:, None, :], o, *tail_args, *seed_args)
         return dq, dk, dv
 
+    # grid-scheduled fallback: lse/delta stay [bh, sq, 1] operands (the
+    # fori-loop q index needs a sublane-dim dynamic slice, which the
+    # dense lane-row arrangement of the tiles kernel cannot provide)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [bh, sq, 1]
+    lse3 = lse[..., None]
     in_specs = [
         pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),        # q
         pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),   # k

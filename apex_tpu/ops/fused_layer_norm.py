@@ -64,14 +64,17 @@ def _ln_fwd_kernel(x_ref, w_ref, b_ref, y_ref, mean_ref, invvar_ref, *, eps, n_c
     invvar_ref[...] = invvar
 
 
+def _ln_block_rows(rows, cols, quota):
+    """Row-block size with at most ``quota`` elements per block, rounded
+    to the Mosaic 8-row sublane grain (or the full row extent — wide
+    cols drove the raw quotient below 8 and failed lowering, r5 fix)."""
+    bm = max(8, min(rows, quota // max(cols, LANE)))
+    return min(rows, bm // 8 * 8) if rows >= 8 else rows
+
+
 def _pallas_ln_fwd(x2d, weight, bias, eps):
     rows, cols = x2d.shape
-    block_rows = max(1, min(rows, 2048 * LANE // max(cols, LANE)))
-    if rows >= 8:
-        # Mosaic sublane grain: the row-block must be a multiple of 8
-        # (or equal to the full row extent) — wide cols drove the raw
-        # quotient below 8 and failed lowering (r5 fix)
-        block_rows = max(8, block_rows // 8 * 8)
+    block_rows = _ln_block_rows(rows, cols, 2048 * LANE)
     grid = (rows + block_rows - 1) // block_rows
     has_w, has_b = weight is not None, bias is not None
 
@@ -136,11 +139,9 @@ def _xla_ln_fwd(x2d, weight, bias, eps):
 
 
 def _ln_bwd_block_rows(rows, cols):
-    """Row-block size keeping x/dy/dx blocks (double-buffered) plus fp32
-    temporaries within a conservative VMEM budget; multiple of the
-    8-row sublane grain (or the full row extent)."""
-    bm = max(8, min(rows, (1 << 19) // max(cols, LANE)))
-    return min(rows, bm // 8 * 8) if rows >= 8 else rows
+    """Backward row-block size: tighter element quota than the forward
+    (x/dy/dx blocks double-buffered plus fp32 temporaries)."""
+    return _ln_block_rows(rows, cols, 1 << 19)
 
 
 def _pallas_ln_bwd(x2d, dy, mean, invvar, weight, has_w, has_b):
