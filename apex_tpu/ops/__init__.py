@@ -9,6 +9,7 @@ reference contract it mirrors.
 
 from apex_tpu.ops.attention import (  # noqa: F401
     flash_attention,
+    flash_attention_qkv,
     ring_attention,
 )
 from apex_tpu.ops.fused_dense import (  # noqa: F401

@@ -1020,6 +1020,347 @@ _flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 # ---------------------------------------------------------------------------
+# Packed-QKV self-attention: transpose-free kernels over the projection
+# layout.
+#
+# The GPT path pays ~10 ms/step (B=8, s=1024) of pure layout churn
+# around the [bh, s, d] kernels: transposes of q/k/v ([b,s,np,hn] ->
+# [b,np,s,hn]) in forward AND in the attn_res recompute, plus the
+# reshape copies of dq/dk/dv back to [b, s, h] (r5 trace, BASELINE.md).
+# These kernels instead consume the qkv projection output DIRECTLY in
+# its Megatron-interleaved layout — [b, s, np*(q64|k64|v64)] — slicing
+# each head's q/k/v statically from the lane dimension (64-granularity
+# static lane slices measured at full MXU rate on v5e), and emit dqkv
+# in the same layout, feeding the projection backward with zero
+# transposes in either direction.  Heads are processed in groups whose
+# lane width is a multiple of 128 (pairs at hn=64) so every HBM-facing
+# block store stays 128-lane aligned.  Self-attention only (dq/dk/dv
+# share the sequence axis, letting one [bq, group*3*hn] store carry all
+# three per row block); cross/mask/varlen shapes use the generic path.
+# ---------------------------------------------------------------------------
+
+
+def _qkv_group(hn):
+    """Heads per kernel instance: smallest count making the per-group
+    lane width (group*3*hn) a multiple of 128."""
+    for g in (1, 2, 4):
+        if (g * 3 * hn) % LANE == 0:
+            return g
+    return None
+
+
+def _make_fwd_kernel_qkv(*, scale, causal, block, s, hn, group,
+                         num_heads, dropout_rate):
+    n_b = s // block
+    w = 3 * hn
+
+    def kernel(*refs):
+        it = iter(refs)
+        qkv_ref = next(it)
+        seed_ref = next(it) if dropout_rate > 0 else None
+        o_ref, lse_ref = next(it), next(it)
+
+        b_idx = pl.program_id(0)
+        hg = pl.program_id(1)
+        for qb in range(n_b):
+            qi = qb * block
+            o_cols, lse_rows = [], []
+            for j in range(group):
+                base = j * w
+                bh_idx = b_idx * num_heads + hg * group + j
+                q = qkv_ref[0, pl.ds(qi, block), base:base + hn]
+                parts = []
+                for kb in range(n_b):
+                    ki = kb * block
+                    if causal and qi < ki:
+                        continue
+                    k = qkv_ref[0, pl.ds(ki, block),
+                                base + hn:base + 2 * hn]
+                    v = qkv_ref[0, pl.ds(ki, block),
+                                base + 2 * hn:base + 3 * hn]
+                    sc = _assemble_scores(q, k, qi, ki, scale=scale,
+                                          causal=causal, sq=s, sk=s)
+                    m_i = jnp.max(sc, axis=-1)
+                    p = _masked_exp(sc, m_i[:, None])
+                    l_i = jnp.sum(p, axis=-1)
+                    if dropout_rate > 0:
+                        keep = _dropout_keep(seed_ref[0, 0], bh_idx, qi,
+                                             ki, block, block,
+                                             dropout_rate)
+                        p = jnp.where(keep, p, 0.0) / (1.0 - dropout_rate)
+                    acc_i = jax.lax.dot_general(
+                        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                    parts.append((m_i, l_i, acc_i))
+                m, l, acc = _merge_parts(parts)
+                l_safe = jnp.where(l == 0, 1.0, l)
+                o_cols.append((acc / l_safe[:, None]).astype(o_ref.dtype))
+                lse_rows.append(
+                    jnp.where(l == 0, _NEG_INF, m + jnp.log(l_safe)))
+            o_ref[0, pl.ds(qi, block), :] = jnp.concatenate(o_cols, -1)
+            for j, row in enumerate(lse_rows):
+                lse_ref[0, 0, j, qb] = jnp.broadcast_to(
+                    row[None, :], (8, block))
+
+    return kernel
+
+
+def _make_bwd_kernel_qkv(*, scale, causal, block, s, hn, group,
+                         num_heads, dropout_rate):
+    n_b = s // block
+    w = 3 * hn
+
+    def kernel(*refs):
+        it = iter(refs)
+        qkv_ref, do_ref, o_ref, lse_ref = (next(it), next(it), next(it),
+                                           next(it))
+        seed_ref = next(it) if dropout_rate > 0 else None
+        dqkv_ref = next(it)
+
+        b_idx = pl.program_id(0)
+        hg = pl.program_id(1)
+        # per head: final dq/dk/dv per row block, held until the joint
+        # [bq, group*3*hn] store keeps every write 128-lane aligned
+        head_grads = []
+        for j in range(group):
+            base = j * w
+            ob = j * hn
+            bh_idx = b_idx * num_heads + hg * group + j
+            deltas = [
+                jnp.sum(do_ref[0, pl.ds(i * block, block),
+                               ob:ob + hn].astype(jnp.float32)
+                        * o_ref[0, pl.ds(i * block, block),
+                                ob:ob + hn].astype(jnp.float32), axis=-1)
+                for i in range(n_b)]
+            dq_parts = [[] for _ in range(n_b)]
+            dk_parts = [[] for _ in range(n_b)]
+            dv_parts = [[] for _ in range(n_b)]
+            for kb in range(n_b):
+                ki = kb * block
+                k = qkv_ref[0, pl.ds(ki, block), base + hn:base + 2 * hn]
+                v = qkv_ref[0, pl.ds(ki, block),
+                            base + 2 * hn:base + 3 * hn]
+                for qb in range(n_b):
+                    qi = qb * block
+                    if causal and qi < ki:
+                        continue
+                    q = qkv_ref[0, pl.ds(qi, block), base:base + hn]
+                    do = do_ref[0, pl.ds(qi, block), ob:ob + hn]
+                    lse = lse_ref[0, 0, j, qb, 0, :]
+                    sc = _assemble_scores(q, k, qi, ki, scale=scale,
+                                          causal=causal, sq=s, sk=s)
+                    p = _masked_exp(sc, lse[:, None])
+                    dp = jax.lax.dot_general(
+                        do, v, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                    if dropout_rate > 0:
+                        keep = _dropout_keep(seed_ref[0, 0], bh_idx, qi,
+                                             ki, block, block,
+                                             dropout_rate)
+                        inv = 1.0 / (1.0 - dropout_rate)
+                        p_drop = jnp.where(keep, p, 0.0) * inv
+                        dp = jnp.where(keep, dp, 0.0) * inv
+                    else:
+                        p_drop = p
+                    dv_parts[kb].append(jax.lax.dot_general(
+                        p_drop.astype(do.dtype), do,
+                        (((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32))
+                    ds = p * (dp - deltas[qb][:, None]) * scale
+                    dk_parts[kb].append(jax.lax.dot_general(
+                        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32))
+                    dq_parts[qb].append(jax.lax.dot_general(
+                        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32))
+
+            def blocksum(parts):
+                return [(_tree_sum(p) if p
+                         else jnp.zeros((block, hn), jnp.float32))
+                        for p in parts]
+
+            head_grads.append((blocksum(dq_parts), blocksum(dk_parts),
+                               blocksum(dv_parts)))
+        for i in range(n_b):
+            cols = []
+            for dqs, dks, dvs in head_grads:
+                cols += [dqs[i], dks[i], dvs[i]]
+            dqkv_ref[0, pl.ds(i * block, block), :] = jnp.concatenate(
+                cols, -1).astype(dqkv_ref.dtype)
+
+    return kernel
+
+
+_QKV_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def _qkv_packed_ok(b, s, num_heads, hn, block, causal, dropout_rate):
+    """Gate for the packed path: TPU backend, aligned shapes, and the
+    backward's resident set (the larger of the two) within VMEM."""
+    del causal, dropout_rate
+    if jax.default_backend() != "tpu":
+        return False
+    group = _qkv_group(hn)
+    if group is None or num_heads % group or num_heads < group:
+        return False
+    if s % block or block % 16 or hn % 64:
+        return False
+    item = 2  # bf16 streams (fp32 inputs also fit: x2 the estimate)
+    n_b = s // block
+    resident = (
+        2 * s * 3 * hn * group * item   # qkv block ×2 buffers
+        + 2 * 2 * s * hn * group * item  # do + o blocks ×2
+        + 2 * group * n_b * 8 * block * 4  # lse slab ×2
+        + 2 * s * 3 * hn * group * item  # dqkv out ×2
+        + group * 3 * s * hn * 4        # held per-head block grads
+        + 3 * block * block * 4         # transient score tiles
+    )
+    return resident <= _QKV_VMEM_BUDGET
+
+
+def _flash_qkv_fwd_pallas(qkv, dropout_seed, num_heads, hn, scale,
+                          causal, block, dropout_rate):
+    b, s, _ = qkv.shape
+    group = _qkv_group(hn)
+    n_hg = num_heads // group
+    n_b = s // block
+    w = group * 3 * hn
+    seed_specs, seed_args = _seed_spec_arg(dropout_rate, dropout_seed)
+    ctx, lse = pl.pallas_call(
+        _make_fwd_kernel_qkv(scale=scale, causal=causal, block=block,
+                             s=s, hn=hn, group=group,
+                             num_heads=num_heads,
+                             dropout_rate=dropout_rate),
+        grid=(b, n_hg),
+        in_specs=[pl.BlockSpec((1, s, w), lambda bi, g: (bi, 0, g))]
+        + seed_specs,
+        out_specs=[
+            pl.BlockSpec((1, s, group * hn), lambda bi, g: (bi, 0, g)),
+            pl.BlockSpec((1, 1, group, n_b, 8, block),
+                         lambda bi, g: (bi, g, 0, 0, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, s, num_heads * hn), qkv.dtype),
+            jax.ShapeDtypeStruct((b, n_hg, group, n_b, 8, block),
+                                 jnp.float32),
+        ],
+        interpret=use_interpret(),
+    )(qkv, *seed_args)
+    return ctx, lse
+
+
+def _flash_qkv_bwd_pallas(qkv, dropout_seed, ctx, lse, dctx, num_heads,
+                          hn, scale, causal, block, dropout_rate):
+    b, s, _ = qkv.shape
+    group = _qkv_group(hn)
+    n_hg = num_heads // group
+    n_b = s // block
+    w = group * 3 * hn
+    seed_specs, seed_args = _seed_spec_arg(dropout_rate, dropout_seed)
+    dqkv = pl.pallas_call(
+        _make_bwd_kernel_qkv(scale=scale, causal=causal, block=block,
+                             s=s, hn=hn, group=group,
+                             num_heads=num_heads,
+                             dropout_rate=dropout_rate),
+        grid=(b, n_hg),
+        in_specs=[
+            pl.BlockSpec((1, s, w), lambda bi, g: (bi, 0, g)),
+            pl.BlockSpec((1, s, group * hn), lambda bi, g: (bi, 0, g)),
+            pl.BlockSpec((1, s, group * hn), lambda bi, g: (bi, 0, g)),
+            pl.BlockSpec((1, 1, group, n_b, 8, block),
+                         lambda bi, g: (bi, g, 0, 0, 0, 0)),
+        ] + seed_specs,
+        out_specs=pl.BlockSpec((1, s, w), lambda bi, g: (bi, 0, g)),
+        out_shape=jax.ShapeDtypeStruct(qkv.shape, qkv.dtype),
+        interpret=use_interpret(),
+    )(qkv, dctx, ctx, lse, *seed_args)
+    return dqkv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def _flash_attention_qkv(qkv, dropout_seed, num_heads, hn, scale,
+                         causal, block, dropout_rate):
+    ctx, _ = _flash_qkv_fwd_pallas(qkv, dropout_seed, num_heads, hn,
+                                   scale, causal, block, dropout_rate)
+    return ctx
+
+
+def _flash_qkv_fwd_rule(qkv, dropout_seed, num_heads, hn, scale, causal,
+                        block, dropout_rate):
+    from jax.ad_checkpoint import checkpoint_name
+
+    ctx, lse = _flash_qkv_fwd_pallas(qkv, dropout_seed, num_heads, hn,
+                                     scale, causal, block, dropout_rate)
+    # same names as the generic path so remat_policy="attn_res" works
+    ctx = checkpoint_name(ctx, "flash_attn_out")
+    lse = checkpoint_name(lse, "flash_attn_lse")
+    return ctx, (qkv, dropout_seed, ctx, lse)
+
+
+def _flash_qkv_bwd_rule(num_heads, hn, scale, causal, block,
+                        dropout_rate, res, dctx):
+    qkv, dropout_seed, ctx, lse = res
+    dqkv = _flash_qkv_bwd_pallas(qkv, dropout_seed, ctx, lse, dctx,
+                                 num_heads, hn, scale, causal, block,
+                                 dropout_rate)
+    return (dqkv, np.zeros((), jax.dtypes.float0))
+
+
+_flash_attention_qkv.defvjp(_flash_qkv_fwd_rule, _flash_qkv_bwd_rule)
+
+
+def flash_attention_qkv(
+    qkv: jnp.ndarray, num_heads: int,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block: int = 512,
+    dropout_rate: float = 0.0,
+    dropout_seed: Optional[Union[int, jnp.ndarray]] = None,
+) -> jnp.ndarray:
+    """Self-attention straight from the QKV projection output.
+
+    ``qkv``: [b, s, num_heads*3*hn] in the Megatron interleaved layout
+    (per head: hn q lanes, hn k lanes, hn v lanes — what
+    ``ColumnParallelLinear`` emits for the fused QKV weight, reference
+    standalone_gpt.py ParallelAttention :283).  Returns the attention
+    context [b, s, num_heads*hn], ready for the output projection.
+
+    On TPU (aligned shapes) this runs the packed Pallas kernels, which
+    read/write the projection layouts directly — no head transposes or
+    gradient reshape copies.  Elsewhere, or for unaligned shapes, it
+    falls back to :func:`flash_attention` on the transposed views
+    (identical math and dropout bits — both paths index the counter
+    hash by ``b*num_heads + head``)."""
+    b, s, three_h = qkv.shape
+    hn = three_h // (3 * num_heads)
+    if scale is None:
+        scale = 1.0 / math.sqrt(hn)
+    # same validation as the generic wrapper — the packed path must not
+    # silently accept what flash_attention rejects (review finding: a
+    # defaulted seed of 0 would drop the SAME positions every step)
+    if dropout_rate > 0:
+        if not 0.0 < dropout_rate < 1.0:
+            raise ValueError(f"dropout_rate {dropout_rate} not in (0, 1)")
+        if dropout_seed is None:
+            raise ValueError("dropout_rate > 0 requires dropout_seed")
+    if (_qkv_packed_ok(b, s, num_heads, hn, min(block, s), causal,
+                       dropout_rate)
+            and not use_interpret()):
+        seed = 0 if dropout_seed is None else dropout_seed
+        return _flash_attention_qkv(qkv, seed, num_heads, hn,
+                                    float(scale), causal, min(block, s),
+                                    float(dropout_rate))
+    q, k, v = (t.transpose(0, 2, 1, 3) for t in (  # [b, np, s, hn]
+        jnp.split(qkv.reshape(b, s, num_heads, 3 * hn), 3, axis=-1)))
+    ctx = flash_attention(q, k, v, causal=causal, scale=scale,
+                          block_q=block, block_k=block,
+                          dropout_rate=dropout_rate,
+                          dropout_seed=dropout_seed)
+    return ctx.transpose(0, 2, 1, 3).reshape(b, s, num_heads * hn)
+
+
+# ---------------------------------------------------------------------------
 # Public API
 # ---------------------------------------------------------------------------
 

@@ -156,15 +156,16 @@ class ParallelAttention:
         do_dropout = dropout_key is not None and cfg.attention_dropout > 0.0
         b, s, _ = h.shape
         qkv = self.qkv.apply(params["qkv"], h)  # [b, s, 3*hidden/tp]
-        qkv = qkv.reshape(b, s, self.np_local, 3 * cfg.kv_channels)
-        q, k, v = jnp.split(qkv, 3, axis=-1)  # each [b, s, np, hn]
         if cfg.use_flash_attention and attention_mask is None:
-            # Pallas flash kernel, causal (the model's mask type): heads
-            # fold into the batch dim, no S×S probs in HBM.  Attention
-            # dropout runs IN-KERNEL (counter-hash masks, FMHA parity) —
-            # the seed derives from the per-TP-rank stream so head-sharded
-            # probs drop independently per rank (tracker discipline)
-            from apex_tpu.ops.attention import flash_attention
+            # Packed flash kernel, causal (the model's mask type):
+            # consumes the QKV projection output directly in its
+            # interleaved per-head layout and emits dqkv the same way —
+            # no head transposes in forward, recompute, or backward
+            # (r5; ~10 ms/step of layout copies at the 350M bench shape).
+            # Attention dropout runs IN-KERNEL (counter-hash masks, FMHA
+            # parity) — the seed derives from the per-TP-rank stream so
+            # head-sharded probs drop independently per rank
+            from apex_tpu.ops.attention import flash_attention_qkv
 
             drop_kwargs = {}
             if do_dropout:
@@ -173,16 +174,12 @@ class ParallelAttention:
                     jnp.uint32).astype(jnp.int32)
                 drop_kwargs = dict(dropout_rate=cfg.attention_dropout,
                                    dropout_seed=seed)
-            qh = q.transpose(0, 2, 1, 3)  # [b, np, s, hn]
-            kh = k.transpose(0, 2, 1, 3)
-            vh = v.transpose(0, 2, 1, 3)
-            ctx = flash_attention(qh, kh, vh, causal=True,
-                                  block_q=cfg.flash_block_q,
-                                  block_k=cfg.flash_block_k,
-                                  **drop_kwargs)
-            ctx = ctx.transpose(0, 2, 1, 3).reshape(
-                b, s, self.np_local * cfg.kv_channels).astype(h.dtype)
+            ctx = flash_attention_qkv(
+                qkv, self.np_local, causal=True,
+                block=cfg.flash_block_q, **drop_kwargs).astype(h.dtype)
             return self.proj.apply(params["proj"], ctx)
+        qkv = qkv.reshape(b, s, self.np_local, 3 * cfg.kv_channels)
+        q, k, v = jnp.split(qkv, 3, axis=-1)  # each [b, s, np, hn]
         # scores [b, np, s, s]; scale 1/sqrt(hn) matches norm_factor (:389)
         scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.kv_channels, jnp.float32))
         scores = jnp.einsum("bqnh,bknh->bnqk", q, k,

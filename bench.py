@@ -455,8 +455,13 @@ def bench_gpt350m():
                 float(loss)
                 chain_dt = min(chain_dt,
                                (time.perf_counter() - t0) / K)
-            assert jnp.isfinite(float(loss))
-        except Exception:
+            assert jnp.isfinite(float(loss)), "chained trainer diverged"
+        except Exception as e:
+            # loud, not silent: a regression that only reproduces under
+            # the scan construction (donation/aliasing) must be visible
+            import sys
+            print(f"[bench] gpt chained-dispatch FAILED: {e!r}"[:300],
+                  file=sys.stderr, flush=True)
             chain_dt = None
     # top-ops capture lives in a SUBPROCESS (main() calls
     # _topops_subprocess) so a poisoned capture cannot lose the record
