@@ -1315,6 +1315,7 @@ def flash_attention_qkv(
     causal: bool = True,
     scale: Optional[float] = None,
     block: int = 512,
+    block_k: Optional[int] = None,
     dropout_rate: float = 0.0,
     dropout_seed: Optional[Union[int, jnp.ndarray]] = None,
 ) -> jnp.ndarray:
@@ -1334,6 +1335,10 @@ def flash_attention_qkv(
     hash by ``b*num_heads + head``)."""
     b, s, three_h = qkv.shape
     hn = three_h // (3 * num_heads)
+    if three_h != 3 * num_heads * hn:
+        raise ValueError(
+            f"qkv last dim {three_h} is not 3*num_heads*head_dim "
+            f"(num_heads={num_heads})")
     if scale is None:
         scale = 1.0 / math.sqrt(hn)
     # same validation as the generic wrapper — the packed path must not
@@ -1344,8 +1349,11 @@ def flash_attention_qkv(
             raise ValueError(f"dropout_rate {dropout_rate} not in (0, 1)")
         if dropout_seed is None:
             raise ValueError("dropout_rate > 0 requires dropout_seed")
-    if (_qkv_packed_ok(b, s, num_heads, hn, min(block, s), causal,
-                       dropout_rate)
+    # the packed kernels tile both axes with ONE block size; an explicit
+    # differing block_k routes to the generic path
+    if (block_k in (None, block)
+            and _qkv_packed_ok(b, s, num_heads, hn, min(block, s),
+                               causal, dropout_rate)
             and not use_interpret()):
         seed = 0 if dropout_seed is None else dropout_seed
         return _flash_attention_qkv(qkv, seed, num_heads, hn,
@@ -1354,7 +1362,8 @@ def flash_attention_qkv(
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (  # [b, np, s, hn]
         jnp.split(qkv.reshape(b, s, num_heads, 3 * hn), 3, axis=-1)))
     ctx = flash_attention(q, k, v, causal=causal, scale=scale,
-                          block_q=block, block_k=block,
+                          block_q=block,
+                          block_k=block if block_k is None else block_k,
                           dropout_rate=dropout_rate,
                           dropout_seed=dropout_seed)
     return ctx.transpose(0, 2, 1, 3).reshape(b, s, num_heads * hn)

@@ -176,7 +176,8 @@ class ParallelAttention:
                                    dropout_seed=seed)
             ctx = flash_attention_qkv(
                 qkv, self.np_local, causal=True,
-                block=cfg.flash_block_q, **drop_kwargs).astype(h.dtype)
+                block=cfg.flash_block_q, block_k=cfg.flash_block_k,
+                **drop_kwargs).astype(h.dtype)
             return self.proj.apply(params["proj"], ctx)
         qkv = qkv.reshape(b, s, self.np_local, 3 * cfg.kv_channels)
         q, k, v = jnp.split(qkv, 3, axis=-1)  # each [b, s, np, hn]

@@ -52,14 +52,40 @@ def _round_key(path):
 
 def _extras(path):
     """Parsed extras dict of a record, or None if the record carries no
-    parsed metrics (unreadable file, ``parsed: null``, missing extras)."""
+    parsed metrics (unreadable file, ``parsed: null``, missing extras).
+    Sections the bench spilled to the committed sidecar file
+    (``spilled_to_sidecar``) are merged back so a size-guarded record
+    never silently un-enforces a gate."""
     try:
         with open(path) as f:
             rec = json.load(f)
     except Exception:
         return None
     extras = (rec.get("parsed") or {}).get("extras")
-    return extras if isinstance(extras, dict) else None
+    if not isinstance(extras, dict):
+        return None
+    spilled = extras.get("spilled_to_sidecar")
+    if spilled:
+        try:
+            with open(os.path.join(os.path.dirname(path),
+                                   "BENCH_TOPOPS.json")) as f:
+                sidecar = json.load(f)
+        except Exception:
+            sidecar = {}
+        missing = []
+        for key in spilled:
+            if key in sidecar:
+                extras.setdefault(key, sidecar[key])
+            else:
+                missing.append(key)
+        gated = {e for e, _, _, _ in DEFAULT_GATES}
+        lost = sorted(set(missing) & gated)
+        assert not lost, (
+            f"{os.path.basename(path)}: gated section(s) {lost} were "
+            "spilled to the sidecar but BENCH_TOPOPS.json does not "
+            "carry them — the gate would be silently un-enforced "
+            "(sidecar write failed or file not committed)")
+    return extras
 
 
 def _latest_record():
@@ -224,6 +250,24 @@ def test_summary_line_always_fits_driver_capture():
     # scalars and small gate sections survive in the line itself
     assert parsed["extras"]["layer_norm"]["fwd_speedup"] == 1.5
     assert parsed["extras"]["matmul_roof_tflops"] == 100.0
+
+
+def test_spilled_sections_merge_back_from_sidecar(tmp_path, monkeypatch):
+    """A record whose gated section was size-spilled to the sidecar must
+    still be enforced — the gate merges it back (r5 incident: the grown
+    summary line spilled layer_norm and would have un-gated it)."""
+    import tests.L0.test_kernel_defaults as mod
+
+    rec = {"parsed": {"extras": {
+        "bench_schema": 3,
+        "spilled_to_sidecar": ["layer_norm"],
+    }}}
+    (tmp_path / "BENCH_r42.json").write_text(json.dumps(rec))
+    (tmp_path / "BENCH_TOPOPS.json").write_text(json.dumps({
+        "layer_norm": {"fwd_speedup": 1.5, "bwd_speedup": 0.17}}))
+    monkeypatch.setattr(mod, "REPO", str(tmp_path))
+    with pytest.raises(AssertionError, match="bwd_speedup = 0.17"):
+        mod.test_every_default_wins_in_latest_record()
 
 
 def test_summary_line_fits_even_on_relay_down_run():
