@@ -144,6 +144,17 @@ def _assemble_scores(q, k, qi, ki, *, scale, causal, sq, sk,
 
 def _make_fwd_kernel(*, scale, causal, block_q, block_k, sq, sk,
                      has_mask, has_seg, dropout_rate):
+    """Online-softmax forward (grid over q blocks) — the streaming form
+    for shapes whose whole-sequence working set exceeds VMEM (the
+    static-tiles kernel covers the rest).  A grouped-unroll variant
+    (tree-merged local partials per loop iteration, the tiles kernel's
+    ILP grafted onto this streaming form) was built and MEASURED
+    LOSING at the deep-k shapes that reach this path — s4096/d128 fwd
+    dropped 93.4 -> 86.4 TF at group size 2 (d=128 keeps the MXU fed
+    already; causal edge-group waste and the extra rescale outweigh the
+    pipelining) — so the classic one-exp-per-score carry body stays."""
+    n_kb_s = sk // block_k
+
     def kernel(*refs):
         it = iter(refs)
         q_ref, k_ref, v_ref = next(it), next(it), next(it)
@@ -161,45 +172,53 @@ def _make_fwd_kernel(*, scale, causal, block_q, block_k, sq, sk,
         m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
         l0 = jnp.zeros((block_q,), jnp.float32)
         acc0 = jnp.zeros((block_q, d), jnp.float32)
-        n_kb = sk // block_k
+        n_grp = n_kb_s
         if causal:
             # dynamic trip count: skip k blocks strictly above this q
             # block's last row (fully masked) — halves the MXU work
             last_row = qi + block_q - 1 + (sk - sq)
-            n_kb = jnp.minimum(n_kb, last_row // block_k + 1)
+            n_grp = jnp.minimum(n_grp, last_row // block_k + 1)
 
         seg_q = segq_ref[0, :, 0] if has_seg else None  # [block_q]
 
-        def body(kb, carry):
-            m, l, acc = carry
-            k = k_ref[0, pl.ds(kb * block_k, block_k), :]
-            v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        def scores_for(kb):
+            ki = kb * block_k
+            k = k_ref[0, pl.ds(ki, block_k), :]
+            v = v_ref[0, pl.ds(ki, block_k), :]
             s = _assemble_scores(
-                q, k, qi, kb * block_k, scale=scale, causal=causal,
+                q, k, qi, ki, scale=scale, causal=causal,
                 sq=sq, sk=sk,
-                mask=(mask_ref[0, :, pl.ds(kb * block_k, block_k)]
+                mask=(mask_ref[0, :, pl.ds(ki, block_k)]
                       if has_mask else None),
                 seg_q=seg_q,
-                seg_k=(segk_ref[0, pl.ds(kb * block_k, block_k), 0]
+                seg_k=(segk_ref[0, pl.ds(ki, block_k), 0]
                        if has_seg else None))
+            return s, v
+
+        def dropped(p, kb):
+            if dropout_rate > 0:
+                keep = _dropout_keep(seed_ref[0, 0], bh_idx, qi,
+                                     kb * block_k, block_q, block_k,
+                                     dropout_rate)
+                p = jnp.where(keep, p, 0.0) / (1.0 - dropout_rate)
+            return p
+
+        def body(kb, carry):
+            m, l, acc = carry
+            s, v = scores_for(kb)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1))
             p = _masked_exp(s, m_new[:, None])
             alpha = jnp.exp(m - m_new)
             # l accumulates UNDROPPED p: normalization must match the
             # softmax (dropout applies to the normalized probs)
             l_new = alpha * l + jnp.sum(p, axis=-1)
-            if dropout_rate > 0:
-                keep = _dropout_keep(seed_ref[0, 0], bh_idx, qi,
-                                     kb * block_k, block_q, block_k,
-                                     dropout_rate)
-                p = jnp.where(keep, p, 0.0) / (1.0 - dropout_rate)
             pv = jax.lax.dot_general(
-                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                dropped(p, kb).astype(v.dtype), v,
+                (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
-            acc_new = acc * alpha[:, None] + pv
-            return m_new, l_new, acc_new
+            return m_new, l_new, acc * alpha[:, None] + pv
 
-        m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
+        m, l, acc = jax.lax.fori_loop(0, n_grp, body, (m0, l0, acc0))
         l_safe = jnp.where(l == 0, 1.0, l)
         o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
         # dense [8, bq] row-broadcast lse block (see the tiles kernel's

@@ -37,6 +37,9 @@ DEFAULT_GATES = [
      "ops.fused_linear_xent: bf16-residual fused head (GPT tp=1 default)"),
     ("flash_attention_s1024", "fwd_speedup_vs_naive", 1.0,
      "ops.attention: Pallas flash forward"),
+    ("flash_attention_qkv", "speedup_vs_unpacked", 0.95,
+     "ops.attention: packed-QKV path (the GPT model default) vs the "
+     "generic kernels plus their layout work — must not lose"),
     ("flash_attention_s4096", "fwd_speedup_vs_naive", 1.0,
      "ops.attention: Pallas flash forward (long context)"),
 ]

@@ -558,6 +558,47 @@ def bench_attention_kernel(bh, s, d, block_q, block_k, measure_floor=False):
     return out
 
 
+def bench_attention_qkv(b, s, nh, hn, block):
+    """The packed-QKV attention path (r5, the GPT model's default):
+    fwd+bwd straight off the interleaved projection layout vs the same
+    math through the generic [bh, s, d] kernels INCLUDING their
+    unavoidable layout work (head transposes in, dq/dk/dv reshape out)
+    — the end-to-end comparison a model actually experiences."""
+    from apex_tpu.ops.attention import flash_attention, flash_attention_qkv
+
+    qkv = jax.random.normal(jax.random.PRNGKey(0), (b, s, nh * 3 * hn),
+                            jnp.bfloat16)
+    r = jax.random.normal(jax.random.PRNGKey(1), (b, s, nh * hn),
+                          jnp.bfloat16)
+    fwd_flops = 4 * b * nh * s * s * hn / 2  # causal
+    flops = 3.5 * fwd_flops  # fwd + 2.5x bwd
+
+    def packed(qkv, r):
+        g = jax.grad(lambda x: jnp.sum(flash_attention_qkv(
+            x, nh, causal=True, block=block).astype(jnp.float32)
+            * r.astype(jnp.float32)))(qkv)
+        return g
+
+    def generic(qkv, r):
+        def loss(x):
+            q, k, v = (t.transpose(0, 2, 1, 3) for t in jnp.split(
+                x.reshape(b, s, nh, 3 * hn), 3, axis=-1))
+            ctx = flash_attention(q, k, v, causal=True, block_q=block,
+                                  block_k=block)
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, nh * hn)
+            return jnp.sum(ctx.astype(jnp.float32) * r.astype(jnp.float32))
+        return jax.grad(loss)(qkv)
+
+    t_p, t_g, how = _timed_pair(packed, generic, (qkv, r), (qkv, r),
+                                [(packed, qkv, (r,)), (generic, qkv, (r,))])
+    return {
+        "fwdbwd_tflops": round(flops / t_p / 1e12, 1),
+        "unpacked_fwdbwd_tflops": round(flops / t_g / 1e12, 1),
+        "speedup_vs_unpacked": round(t_g / t_p, 2),
+        "timing": how,
+    }
+
+
 def _attention_dot_floor(bh, s, d, block_q, block_k):
     """TFLOPS of a kernel doing ONLY the two attention matmuls (no
     softmax) — the MXU ceiling the fwd kernel is measured against.  The
@@ -1029,6 +1070,10 @@ def main():
                 r["fwdbwd_frac_of_dot_floor"] = round(
                     r["fwdbwd_tflops"] / r["dot_floor_tflops"], 3)
             extras["flash_attention_s1024"] = r
+        r = attempt("flash_attention_qkv",
+                    lambda: bench_attention_qkv(8, 1024, 16, 64, 512))
+        if r is not None:
+            extras["flash_attention_qkv"] = r
         r = attempt("flash_attention_s4096",
                     lambda: bench_attention_kernel(16, 4096, 128, 512, 512))
         if r is not None:

@@ -50,12 +50,17 @@ def _round_key(path):
     return (int(m.group(1)), m.group(2))
 
 
-def _extras(path):
+def _extras(path, merge_sidecar=False):
     """Parsed extras dict of a record, or None if the record carries no
     parsed metrics (unreadable file, ``parsed: null``, missing extras).
-    Sections the bench spilled to the committed sidecar file
-    (``spilled_to_sidecar``) are merged back so a size-guarded record
-    never silently un-enforces a gate."""
+
+    ``merge_sidecar`` is set only for the record SELECTED as the gate
+    authority: sections the bench spilled to the committed sidecar file
+    (``spilled_to_sidecar``) are merged back, and a gated section that
+    cannot be recovered is a hard failure — never for mere selection
+    scans (the sidecar is rewritten each bench run, so it only speaks
+    for the newest record; older records' spilled sections rotate out
+    and must not be graded against a different run's values)."""
     try:
         with open(path) as f:
             rec = json.load(f)
@@ -65,7 +70,7 @@ def _extras(path):
     if not isinstance(extras, dict):
         return None
     spilled = extras.get("spilled_to_sidecar")
-    if spilled:
+    if spilled and merge_sidecar:
         try:
             with open(os.path.join(os.path.dirname(path),
                                    "BENCH_TOPOPS.json")) as f:
@@ -105,13 +110,15 @@ def _latest_record():
     for path in reversed(driver):
         extras = _extras(path)
         if extras is not None and extras.get("bench_schema", 0) >= 2:
-            return os.path.basename(path), extras
+            return os.path.basename(path), _extras(path,
+                                                   merge_sidecar=True)
     for path in reversed(paths):  # supplement: builder-captured records
         if path in driver:
             continue
         extras = _extras(path)
         if extras is not None and extras.get("bench_schema", 0) >= 2:
-            return os.path.basename(path), extras
+            return os.path.basename(path), _extras(path,
+                                                   merge_sidecar=True)
     return None, None
 
 
