@@ -1,0 +1,92 @@
+"""d=64 MXU lane question, compute-bound and CSE-proof: each unrolled
+dot consumes a distinct slice of a VMEM-resident operand."""
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+sys.path.insert(0, "/root/repo")
+from apex_tpu.profiling.trace_report import device_time_ms  # noqa: E402
+
+m, n, reps, U = 512, 512, 32, 16
+DN = (((1,), (1,)), ((), ()))
+
+
+def dev_ms(fn, *args, steps=8):
+    fn = jax.jit(fn)
+    out = fn(*args)
+    jax.block_until_ready(out)
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0].astype(jnp.float32)))
+    return device_time_ms(fn, *args, steps=steps)
+
+
+def kern_A(qa_ref, qb_ref, k1_ref, k2_ref, o_ref):
+    acc = jnp.zeros((m, n), jnp.float32)
+    for i in range(U):
+        s1 = jax.lax.dot_general(qa_ref[0, :, i * 64:(i + 1) * 64],
+                                 k1_ref[0], DN,
+                                 preferred_element_type=jnp.float32)
+        s2 = jax.lax.dot_general(qb_ref[0, :, i * 64:(i + 1) * 64],
+                                 k2_ref[0], DN,
+                                 preferred_element_type=jnp.float32)
+        acc = acc + s1 + s2
+    o_ref[0] = acc
+
+
+def kern_B(qp_ref, kp_ref, o_ref):
+    acc = jnp.zeros((2 * m, n), jnp.float32)
+    for i in range(U):
+        s = jax.lax.dot_general(qp_ref[0, :, i * 128:(i + 1) * 128],
+                                kp_ref[0], DN,
+                                preferred_element_type=jnp.float32)
+        acc = acc + s
+    o_ref[0] = acc
+
+
+def kern_C(qc_ref, kc_ref, o_ref):
+    acc = jnp.zeros((m, n), jnp.float32)
+    for i in range(U):
+        s = jax.lax.dot_general(qc_ref[0, :, i * 128:(i + 1) * 128],
+                                kc_ref[0], DN,
+                                preferred_element_type=jnp.float32)
+        acc = acc + s
+    o_ref[0] = acc
+
+
+key = jax.random.PRNGKey(1)
+qa = jax.random.normal(key, (1, m, 64 * U), jnp.bfloat16)
+qb = qa * 0.5
+k1 = jax.random.normal(key, (1, n, 64), jnp.bfloat16)
+k2 = k1 + 1
+# B: block-diagonal packing of the two heads' q slices -> [2m, 128U]
+qp = jnp.concatenate([
+    jnp.concatenate([qa.reshape(1, m, U, 64),
+                     jnp.zeros((1, m, U, 64), jnp.bfloat16)], -1),
+    jnp.concatenate([jnp.zeros((1, m, U, 64), jnp.bfloat16),
+                     qb.reshape(1, m, U, 64)], -1)],
+    1).reshape(1, 2 * m, U * 128)
+kp = jnp.concatenate([k1, k2], -1)  # [1, n, 128]
+qc = jnp.concatenate([qa, qa], -1).reshape(1, m, 2 * U, 64).reshape(
+    1, m, 2 * U * 64)  # [m, 128U]
+kc = jnp.concatenate([k1, k1], -1)
+
+
+def run(kern, outshape, *ops):
+    return pl.pallas_call(
+        kern, grid=(reps,),
+        in_specs=[pl.BlockSpec((1,) + o.shape[1:], lambda b: (0, 0, 0))
+                  for o in ops],
+        out_specs=pl.BlockSpec((1,) + outshape, lambda b: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1,) + outshape, jnp.float32),
+    )(*ops)
+
+
+tA = dev_ms(lambda: run(kern_A, (m, n), qa, qb, k1, k2))
+tB = dev_ms(lambda: run(kern_B, (2 * m, n), qp, kp))
+tC = dev_ms(lambda: run(kern_C, (m, n), qc, kc))
+useful = reps * U * 2 * m * n * 64 * 2  # two-head useful flops
+print(f"A two d=64 dots : {tA:.3f} ms  {useful / tA / 1e9 / 1e3:.1f} TF useful")
+print(f"B packed blkdiag: {tB:.3f} ms  {useful / tB / 1e9 / 1e3:.1f} TF useful")
+print(f"C one d=128 dot : {tC:.3f} ms  {useful / tC / 1e9 / 1e3:.1f} TF at "
+      "equal time (ceiling if packing were free)")
