@@ -156,28 +156,59 @@ class ParallelAttention:
         do_dropout = dropout_key is not None and cfg.attention_dropout > 0.0
         b, s, _ = h.shape
         qkv = self.qkv.apply(params["qkv"], h)  # [b, s, 3*hidden/tp]
+        # flash-path dropout runs IN-KERNEL (counter-hash masks, FMHA
+        # parity) — the seed derives from the per-TP-rank stream so
+        # head-sharded probs drop independently per rank
+        flash_drop = {}
+        if cfg.use_flash_attention and do_dropout:
+            seed = jax.random.bits(
+                model_parallel_dropout_key(dropout_key), (),
+                jnp.uint32).astype(jnp.int32)
+            flash_drop = dict(dropout_rate=cfg.attention_dropout,
+                              dropout_seed=seed)
+        # the module's mask type, not the mask's presence, decides
+        # causality (GPT: causal even WITH an extra padding mask)
+        is_causal = self.softmax.attn_mask_type == AttnMaskType.causal
         if cfg.use_flash_attention and attention_mask is None:
-            # Packed flash kernel, causal (the model's mask type):
-            # consumes the QKV projection output directly in its
-            # interleaved per-head layout and emits dqkv the same way —
-            # no head transposes in forward, recompute, or backward
-            # (r5; ~10 ms/step of layout copies at the 350M bench shape).
-            # Attention dropout runs IN-KERNEL (counter-hash masks, FMHA
-            # parity) — the seed derives from the per-TP-rank stream so
-            # head-sharded probs drop independently per rank
+            # Packed flash kernel: consumes the QKV projection output
+            # directly in its interleaved per-head layout and emits
+            # dqkv the same way — no head transposes in forward,
+            # recompute, or backward (r5; ~10 ms/step of layout copies
+            # at the 350M bench shape)
             from apex_tpu.ops.attention import flash_attention_qkv
 
-            drop_kwargs = {}
-            if do_dropout:
-                seed = jax.random.bits(
-                    model_parallel_dropout_key(dropout_key), (),
-                    jnp.uint32).astype(jnp.int32)
-                drop_kwargs = dict(dropout_rate=cfg.attention_dropout,
-                                   dropout_seed=seed)
             ctx = flash_attention_qkv(
-                qkv, self.np_local, causal=True,
+                qkv, self.np_local, causal=is_causal,
                 block=cfg.flash_block_q, block_k=cfg.flash_block_k,
-                **drop_kwargs).astype(h.dtype)
+                **flash_drop).astype(h.dtype)
+            return self.proj.apply(params["proj"], ctx)
+        if (cfg.use_flash_attention and attention_mask is not None
+                and attention_mask.ndim == 4
+                and attention_mask.shape[1] == 1
+                and attention_mask.shape[2] == 1):
+            # KEY-PADDING mask ([b, 1, 1, s], True = masked key — the
+            # BERT form): flash handles it as segment ids with all-ones
+            # query ids, reproducing key-side-only masking exactly (pad
+            # QUERY rows still attend real keys, like the reference's
+            # additive mask; the reference FMHA existed for precisely
+            # this BERT varlen case, fmha.py:33-75).  Composes with the
+            # causal flag for causal-model + padding-mask callers.
+            from apex_tpu.ops.attention import flash_attention
+
+            np_l, hn = self.np_local, cfg.kv_channels
+            q4, k4, v4 = (
+                t.transpose(0, 2, 1, 3)  # [b, np, s, hn]
+                for t in jnp.split(
+                    qkv.reshape(b, s, np_l, 3 * hn), 3, axis=-1))
+            keep = (~attention_mask[:, 0, 0, :].astype(bool)).astype(
+                jnp.int32)  # [b, s], 1 = real token
+            ctx = flash_attention(
+                q4, k4, v4, causal=is_causal,
+                segment_ids=(jnp.ones_like(keep), keep),
+                block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+                **flash_drop)
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(
+                b, s, np_l * hn).astype(h.dtype)
             return self.proj.apply(params["proj"], ctx)
         qkv = qkv.reshape(b, s, self.np_local, 3 * cfg.kv_channels)
         q, k, v = jnp.split(qkv, 3, axis=-1)  # each [b, s, np, hn]

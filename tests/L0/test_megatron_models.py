@@ -245,6 +245,44 @@ class TestBert:
         assert np.isfinite(float(loss))
         assert binary.shape == (B, 2)
 
+    def test_bert_flash_matches_softmax_path(self):
+        """BERT's key-padding mask through the flash path (segment ids
+        with all-ones query ids — the FMHA varlen role, r5) must match
+        the fused-softmax path: key-side-only masking semantics, pad
+        query rows included."""
+        kw = dict(num_layers=2, hidden_size=32, num_attention_heads=4,
+                  vocab_size=VOCAB, max_position_embeddings=SEQ,
+                  tp_size=1, add_binary_head=False)
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel(1, 1)
+        m_soft = BertModel(BertConfig(**kw))
+        m_flash = BertModel(BertConfig(use_flash_attention=True, **kw))
+        master = m_soft.init_master(jax.random.PRNGKey(0))
+        params = m_soft.shard_master(master, 0)
+        tokens = _tokens(jax.random.PRNGKey(1))
+        # real padding: last third of every sequence masked
+        mask = jnp.concatenate(
+            [jnp.ones((B, SEQ - SEQ // 3), jnp.int32),
+             jnp.zeros((B, SEQ // 3), jnp.int32)], axis=1)
+        labels = _tokens(jax.random.PRNGKey(2))
+
+        def run(model):
+            def f(p, t, m, l):
+                losses, _ = model.apply(p, t, attention_mask=m,
+                                        lm_labels=l)
+                return losses
+            return shard_map(
+                f, mesh=mesh, in_specs=(P(), P(), P(), P()),
+                out_specs=P(), check_rep=False)(params, tokens, mask,
+                                                labels)
+
+        l_soft = run(m_soft)
+        l_flash = run(m_flash)
+        parallel_state.destroy_model_parallel()
+        np.testing.assert_allclose(np.asarray(l_flash),
+                                   np.asarray(l_soft),
+                                   rtol=2e-3, atol=2e-3)
+
     def test_bert_tp_matches_tp1(self):
         cfg1 = BertConfig(num_layers=1, hidden_size=32, num_attention_heads=4,
                           vocab_size=VOCAB, max_position_embeddings=SEQ,
@@ -313,6 +351,44 @@ class TestFlashAndRemat:
                                      remat=True), master, tokens, labels)
         np.testing.assert_allclose(flash, base, rtol=2e-5, atol=2e-6)
         np.testing.assert_allclose(remat, base, rtol=2e-5, atol=2e-6)
+
+    def test_causal_model_keeps_causality_with_padding_mask(self):
+        """A causal model handed an ADDITIONAL [b,1,1,s] padding mask
+        must stay causal on the flash path (r5 review finding: the
+        key-padding flash branch once dropped the causal mask)."""
+        from apex_tpu.transformer.testing.standalone_gpt import (
+            ParallelAttention)
+
+        cfg = GPTConfig(num_layers=1, hidden_size=32,
+                        num_attention_heads=4, vocab_size=VOCAB,
+                        max_position_embeddings=SEQ, tp_size=1)
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel(1, 1)
+        attn_soft = ParallelAttention(cfg)
+        attn_flash = ParallelAttention(
+            GPTConfig(num_layers=1, hidden_size=32,
+                      num_attention_heads=4, vocab_size=VOCAB,
+                      max_position_embeddings=SEQ, tp_size=1,
+                      use_flash_attention=True))
+        params = attn_soft.shard_master(
+            attn_soft.init_master(jax.random.PRNGKey(0)), 0)
+        h = jax.random.normal(jax.random.PRNGKey(1), (B, SEQ, 32))
+        pad = jnp.concatenate(
+            [jnp.zeros((B, SEQ - 2), bool), jnp.ones((B, 2), bool)],
+            axis=1)[:, None, None, :]  # True = masked key
+
+        def run(attn):
+            return shard_map(
+                lambda p, h: attn.apply(p, h, attention_mask=pad),
+                mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+                check_rep=False)(params, h)
+
+        o_soft = run(attn_soft)
+        o_flash = run(attn_flash)
+        parallel_state.destroy_model_parallel()
+        np.testing.assert_allclose(np.asarray(o_flash),
+                                   np.asarray(o_soft),
+                                   rtol=2e-4, atol=2e-4)
 
     def test_remat_grads_match(self):
         kw = dict(num_layers=2, hidden_size=32, num_attention_heads=4,
