@@ -24,10 +24,19 @@ from apex_tpu.utils.tree import tree_isfinite
 
 class LossScaleState(NamedTuple):
     """Carried in the train state. ``unskipped`` mirrors reference
-    ``LossScaler._unskipped`` (scaler.py:51)."""
+    ``LossScaler._unskipped`` (scaler.py:51); ``skipped`` is the monotonic
+    count of overflow-skipped steps (the number the reference only prints —
+    "Gradient overflow.  Skipping step" — made queryable so divergence
+    guards and logging can consume it, see :mod:`apex_tpu.resilience`).
+
+    Back-compat: ``skipped=None`` yields the legacy 2-leaf pytree —
+    ``update`` then keeps it None (stable treedef), and a checkpoint
+    written before the counter existed restores into a target built with
+    ``state._replace(skipped=None)``."""
 
     loss_scale: jnp.ndarray  # f32 scalar
     unskipped: jnp.ndarray  # i32 scalar: overflow-free steps since last growth
+    skipped: jnp.ndarray = None  # i32 scalar: total steps skipped on overflow
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,6 +62,7 @@ class LossScaler:
         return LossScaleState(
             loss_scale=jnp.asarray(self.init_scale, jnp.float32),
             unskipped=jnp.asarray(0, jnp.int32),
+            skipped=jnp.asarray(0, jnp.int32),
         )
 
     def scale(self, loss, state: LossScaleState):
@@ -90,9 +100,18 @@ class LossScaler:
         scaler.py:197-217): on overflow scale/=factor, clamp to min_scale,
         reset the window; else grow ×factor every ``scale_window`` clean
         steps, capped at max_scale."""
-        if not self.dynamic:
-            return state
         finite = jnp.asarray(finite)
+        # skipped counts even under a static scaler: the step WAS dropped
+        # (step_if_finite), only the scale stays put.  A legacy 2-leaf state
+        # (skipped=None — e.g. the restore target for a checkpoint written
+        # before the counter existed) stays 2-leaf: never grow the treedef
+        # mid-train (jit carries / lax.scan need a stable structure).
+        if state.skipped is None:
+            skipped = None
+        else:
+            skipped = jnp.where(finite, state.skipped, state.skipped + 1)
+        if not self.dynamic:
+            return state._replace(skipped=skipped)
         unskipped = jnp.where(finite, state.unskipped + 1, 0)
         grow = unskipped >= self.scale_window
         scale = jnp.where(
@@ -101,7 +120,8 @@ class LossScaler:
             jnp.maximum(state.loss_scale / self.scale_factor, self.min_scale),
         )
         unskipped = jnp.where(grow, 0, unskipped)
-        return LossScaleState(loss_scale=scale, unskipped=unskipped)
+        return LossScaleState(loss_scale=scale, unskipped=unskipped,
+                              skipped=skipped)
 
 
 def state_dict(state: LossScaleState) -> dict:
@@ -110,12 +130,15 @@ def state_dict(state: LossScaleState) -> dict:
     return {
         "loss_scale": float(state.loss_scale),
         "unskipped": int(state.unskipped),
+        "skipped": int(state.skipped) if state.skipped is not None else 0,
     }
 
 
 def load_state_dict(d: dict) -> LossScaleState:
-    """Reference frontend.py:373-400."""
+    """Reference frontend.py:373-400.  ``skipped`` defaults to 0 when
+    loading a state dict written before the counter existed."""
     return LossScaleState(
         loss_scale=jnp.asarray(d["loss_scale"], jnp.float32),
         unskipped=jnp.asarray(d["unskipped"], jnp.int32),
+        skipped=jnp.asarray(d.get("skipped", 0), jnp.int32),
     )

@@ -10,10 +10,13 @@ Usage::
 """
 
 from apex_tpu.checkpoint.checkpoint import (
+    CheckpointCorruptionError,
+    RetryPolicy,
     latest_step,
     restore_checkpoint,
     save_checkpoint,
     step_dir,
+    verify_checkpoint,
 )
 from apex_tpu.checkpoint.train_state import TrainState
 
@@ -21,6 +24,9 @@ __all__ = [
     "TrainState",
     "save_checkpoint",
     "restore_checkpoint",
+    "verify_checkpoint",
     "latest_step",
     "step_dir",
+    "CheckpointCorruptionError",
+    "RetryPolicy",
 ]

@@ -34,10 +34,13 @@ per-process and re-shard via device_put.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import shutil
-from typing import Any, Optional
+import time
+import zlib
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -49,6 +52,48 @@ _ARRAYS = "arrays.npz"
 _PACK = "arrays.pack"
 _LATEST = "latest"
 _PACK_ALIGN = 64
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint on disk failed integrity verification (missing files,
+    unreadable archive, truncated arrays, or CRC32 digest mismatch)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential-backoff retry for storage I/O during save.
+
+    Transient storage faults (GCS 5xx, NFS hiccups, full-but-recovering
+    disks) should not kill a training run mid-save; each save attempt
+    rewrites its tmp dir from scratch, so retrying is idempotent."""
+
+    max_attempts: int = 3
+    base_delay: float = 0.05  # seconds; doubles per attempt
+    max_delay: float = 2.0
+    retryable: tuple = (OSError,)
+
+    def delay(self, attempt: int) -> float:
+        return min(self.base_delay * (2.0 ** attempt), self.max_delay)
+
+
+# Test-only fault-injection point (see apex_tpu.resilience.chaos). When set,
+# called as hook(event, path) at each storage operation; it may raise to
+# simulate a write failure or sleep to simulate slow storage.  Events:
+# "write_arrays", "write_manifest", "commit", "read_arrays".
+_fault_hook: Optional[Callable[[str, str], None]] = None
+
+
+def set_fault_hook(hook: Optional[Callable[[str, str], None]]):
+    """Install (or clear, with None) the storage fault hook. Returns the
+    previous hook so tests can restore it."""
+    global _fault_hook
+    prev, _fault_hook = _fault_hook, hook
+    return prev
+
+
+def _fault(event: str, path: str) -> None:
+    if _fault_hook is not None:
+        _fault_hook(event, path)
 
 # dtypes stored as fp32 on disk for precision portability (O2StateDictHook
 # parity, _initialize.py:133-142)
@@ -140,11 +185,15 @@ def _complete_steps(ckpt_dir: str) -> list:
         for name in os.listdir(ckpt_dir):
             if not name.startswith("step_") or name.endswith(".tmp"):
                 continue
-            try:
-                s = int(name[len("step_") :])
-            except ValueError:
+            digits = name[len("step_"):]
+            # int() alone is too permissive ("+3", "1_0", " 3" all parse) and
+            # str.isdigit alone accepts Unicode digits int() may reject ("³")
+            # — only the exact zero-padded ASCII-decimal form
+            # save_checkpoint writes counts as a checkpoint
+            if not (digits.isascii() and digits.isdecimal()):
                 continue
-            if os.path.exists(os.path.join(ckpt_dir, name, _MANIFEST)):
+            s = int(digits)
+            if os.path.isfile(os.path.join(ckpt_dir, name, _MANIFEST)):
                 steps.append(s)
     return sorted(steps)
 
@@ -175,6 +224,8 @@ def save_checkpoint(
     keep: Optional[int] = None,
     fp32_portable: bool = True,
     packed: bool = False,
+    blocking: bool = True,
+    retry: Optional[RetryPolicy] = None,
 ) -> str:
     """Write ``tree`` as checkpoint ``step`` under ``ckpt_dir``.
 
@@ -185,7 +236,22 @@ def save_checkpoint(
     ``packed`` — store leaves in one flat superblock file gathered by the
     native threaded pack (apex_C-parity host runtime,
     :mod:`apex_tpu._native`) instead of npz zip framing; restore
-    auto-detects either format.  Returns the checkpoint directory path.
+    auto-detects either format.
+
+    ``blocking=False`` — return as soon as the tree is snapshotted to host
+    memory; disk serialization runs on a background writer thread
+    (:mod:`apex_tpu.resilience.async_checkpoint`) so the train loop keeps
+    stepping during the write (the snapshot means later donation/mutation
+    of the device buffers cannot corrupt the save).  Any save — async or
+    blocking — first *fences* on a still-in-flight async write, as does
+    interpreter exit; a failed background write (after retries) re-raises
+    at that fence.  ``retry`` — :class:`RetryPolicy` for transient storage
+    errors (each attempt rewrites the tmp dir from scratch).
+
+    Every array's CRC32 digest is recorded in ``manifest.json`` for
+    restore-side integrity verification (:func:`verify_checkpoint`).
+
+    Returns the checkpoint directory path.
     """
     # Only process 0 writes; the guard precedes any device_get so non-writing
     # hosts pay no host transfer. (Globally-sharded multi-host arrays would
@@ -193,6 +259,12 @@ def save_checkpoint(
     # per-rank torch.save, SURVEY §5.4.)
     if jax.process_index() != 0:
         return step_dir(ckpt_dir, step)
+
+    # fence: at most one write in flight; a prior async save must land (or
+    # surface its error) before this one starts
+    from apex_tpu.resilience import async_checkpoint as _async
+
+    _async.wait_for_save()
 
     leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
     spec_map = _spec_map(shardings, tree) if shardings is not None else {}
@@ -244,6 +316,69 @@ def save_checkpoint(
         manifest["leaves"][key] = entry
         arrays[key] = val
 
+    # everything below is pure host/disk work on the snapshot — safe to run
+    # on the background writer thread
+    if blocking:
+        _write_checkpoint_files(ckpt_dir, step, manifest, arrays,
+                                packed=packed, keep=keep, retry=retry)
+    else:
+        _async.submit_save(
+            lambda: _write_checkpoint_files(ckpt_dir, step, manifest, arrays,
+                                            packed=packed, keep=keep,
+                                            retry=retry),
+            label=f"{ckpt_dir}:step_{int(step)}")
+    return step_dir(ckpt_dir, step)
+
+
+def _write_checkpoint_files(ckpt_dir: str, step: int, manifest: dict,
+                            arrays: dict, *, packed: bool,
+                            keep: Optional[int],
+                            retry: Optional[RetryPolicy]) -> str:
+    """Disk phase of a save: tmp dir -> arrays + manifest -> atomic rename ->
+    latest marker -> keep-GC.  Retries the whole tmp-dir write on transient
+    storage errors (each attempt starts from a fresh tmp dir)."""
+    # CRC32 digests of the bytes as STORED (what restore-side verification
+    # re-hashes off disk).  Hashed here — on the writer thread for async
+    # saves — so ``blocking=False`` returns after the device snapshot alone,
+    # without a per-leaf hash + tobytes copy stalling the train loop.
+    for k, entry in manifest["leaves"].items():
+        entry["crc32"] = zlib.crc32(arrays[k].tobytes()) & 0xFFFFFFFF
+    retry = retry or RetryPolicy(max_attempts=1)
+    final = step_dir(ckpt_dir, step)
+    last_err = None
+    for attempt in range(retry.max_attempts):
+        try:
+            _write_step_dir_once(ckpt_dir, step, manifest, arrays,
+                                 packed=packed)
+            break
+        except retry.retryable as e:
+            last_err = e
+            shutil.rmtree(final + ".tmp", ignore_errors=True)
+            if attempt + 1 >= retry.max_attempts:
+                raise
+            time.sleep(retry.delay(attempt))
+    else:  # pragma: no cover — loop always breaks or raises
+        raise last_err
+
+    with open(os.path.join(ckpt_dir, _LATEST), "w") as f:
+        f.write(str(int(step)))
+
+    if keep is not None:
+        # prune by write recency, never the checkpoint just written — a
+        # rollback-resume that saves a *lower* step than what's on disk must
+        # not delete its own output
+        others = [
+            s for s in _complete_steps(ckpt_dir) if s != int(step)
+        ]
+        others.sort(key=lambda s: os.path.getmtime(step_dir(ckpt_dir, s)))
+        for s in others[: max(0, len(others) - (keep - 1))]:
+            shutil.rmtree(step_dir(ckpt_dir, s), ignore_errors=True)
+    return final
+
+
+def _write_step_dir_once(ckpt_dir: str, step: int, manifest: dict,
+                         arrays: dict, *, packed: bool) -> None:
+    """One attempt at writing + committing ``step_<N>/``."""
     os.makedirs(ckpt_dir, exist_ok=True)
     final = step_dir(ckpt_dir, step)
     tmp = final + ".tmp"
@@ -264,28 +399,98 @@ def save_checkpoint(
             offsets.append(off)
             off += -(-a.nbytes // _PACK_ALIGN) * _PACK_ALIGN
         buf = _native.pack_host(contig, offsets, off)
+        _fault("write_arrays", os.path.join(tmp, _PACK))
         buf.tofile(os.path.join(tmp, _PACK))
     else:
+        _fault("write_arrays", os.path.join(tmp, _ARRAYS))
         np.savez(os.path.join(tmp, _ARRAYS), **arrays)
+    _fault("write_manifest", os.path.join(tmp, _MANIFEST))
     with open(os.path.join(tmp, _MANIFEST), "w") as f:
         json.dump(manifest, f, indent=1)
+    _fault("commit", final)
     if os.path.exists(final):
         shutil.rmtree(final)
     os.rename(tmp, final)
-    with open(os.path.join(ckpt_dir, _LATEST), "w") as f:
-        f.write(str(int(step)))
 
-    if keep is not None:
-        # prune by write recency, never the checkpoint just written — a
-        # rollback-resume that saves a *lower* step than what's on disk must
-        # not delete its own output
-        others = [
-            s for s in _complete_steps(ckpt_dir) if s != int(step)
-        ]
-        others.sort(key=lambda s: os.path.getmtime(step_dir(ckpt_dir, s)))
-        for s in others[: max(0, len(others) - (keep - 1))]:
-            shutil.rmtree(step_dir(ckpt_dir, s), ignore_errors=True)
-    return final
+
+def _stored_dtype(entry: dict):
+    """On-disk dtype of a manifest leaf (the single owner of the
+    stored_dtype decode — the chaos harness reuses it to locate leaf
+    bytes)."""
+    sd = entry.get("stored_dtype")
+    return jnp.dtype(sd if sd == "float32"
+                     else "uint16" if sd == "uint16_bits"
+                     else entry["dtype"])
+
+
+def _load_manifest_and_data(d: str, *, verify: bool):
+    """Read manifest + raw stored arrays from checkpoint dir ``d``.
+
+    ``verify=True`` treats every read/parse failure as corruption (raising
+    :class:`CheckpointCorruptionError`) and checks each array's stored
+    bytes against the manifest's CRC32 digest.  ``verify=False`` preserves
+    the historical raw exceptions."""
+    try:
+        _fault("read_arrays", d)
+        with open(os.path.join(d, _MANIFEST)) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        if verify:
+            raise CheckpointCorruptionError(
+                f"unreadable manifest in {d}: {e}") from e
+        raise
+    pack_path = os.path.join(d, _PACK)
+    try:
+        if os.path.exists(pack_path):  # format 2: flat superblock
+            buf = np.fromfile(pack_path, np.uint8)
+            data = {}
+            for k, e in manifest["leaves"].items():
+                cnt = int(np.prod(e["shape"])) if e["shape"] else 1
+                data[k] = np.frombuffer(buf, _stored_dtype(e), cnt,
+                                        e["offset"]).reshape(e["shape"])
+        else:
+            with np.load(os.path.join(d, _ARRAYS)) as npz:
+                data = {k: npz[k] for k in npz.files}
+    except Exception as e:
+        # truncated pack (frombuffer ValueError), truncated/garbled npz
+        # (zipfile.BadZipFile, EOFError, OSError, KeyError) — with
+        # verify, all of these are one condition: a corrupt checkpoint
+        if verify:
+            raise CheckpointCorruptionError(
+                f"unreadable arrays in {d}: {type(e).__name__}: {e}") from e
+        raise
+    if verify:
+        problems = []
+        for k, e in manifest["leaves"].items():
+            if k not in data:
+                problems.append(f"missing stored array {k!r}")
+                continue
+            want = e.get("crc32")
+            if want is None:
+                continue  # pre-digest manifest: nothing to check against
+            got = zlib.crc32(np.asarray(data[k]).tobytes()) & 0xFFFFFFFF
+            if got != want:
+                problems.append(
+                    f"CRC32 mismatch for {k!r}: stored digest {want}, "
+                    f"bytes on disk hash to {got}")
+        if problems:
+            raise CheckpointCorruptionError(
+                f"checkpoint at {d} failed integrity verification: "
+                + "; ".join(problems))
+    return manifest, data
+
+
+def verify_checkpoint(ckpt_dir: str, step: Optional[int] = None) -> int:
+    """Check integrity of checkpoint ``step`` (default: latest) under
+    ``ckpt_dir``: files readable, every manifest leaf present, CRC32
+    digests match the bytes on disk.  Returns the verified step, or raises
+    :class:`CheckpointCorruptionError` / :class:`FileNotFoundError`."""
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found under {ckpt_dir}")
+    _load_manifest_and_data(step_dir(ckpt_dir, step), verify=True)
+    return step
 
 
 def restore_checkpoint(
@@ -295,6 +500,7 @@ def restore_checkpoint(
     step: Optional[int] = None,
     mesh: Optional[Mesh] = None,
     shardings: Any = None,
+    verify: bool = False,
 ):
     """Restore a checkpoint into (optionally) ``target``'s structure.
 
@@ -309,6 +515,11 @@ def restore_checkpoint(
       (a pytree of PartitionSpec) or, failing that, from the manifest. The
       mesh may differ in size/shape from the one that saved — this is how
       restore-on-a-different-dp-size works.
+    - ``verify=True``: re-hash every stored array against the manifest's
+      CRC32 digests before materializing, and surface any read failure as
+      :class:`CheckpointCorruptionError` (see
+      :func:`apex_tpu.resilience.restore_resilient` for automatic fallback
+      to the newest intact older checkpoint).
 
     Returns ``(tree, step)``.
     """
@@ -317,23 +528,7 @@ def restore_checkpoint(
         if step is None:
             raise FileNotFoundError(f"no checkpoint found under {ckpt_dir}")
     d = step_dir(ckpt_dir, step)
-    with open(os.path.join(d, _MANIFEST)) as f:
-        manifest = json.load(f)
-    pack_path = os.path.join(d, _PACK)
-    if os.path.exists(pack_path):  # format 2: flat superblock
-        buf = np.fromfile(pack_path, np.uint8)
-        data = {}
-        for k, e in manifest["leaves"].items():
-            sd = e.get("stored_dtype")
-            dt = jnp.dtype(sd if sd == "float32"
-                           else "uint16" if sd == "uint16_bits"
-                           else e["dtype"])
-            cnt = int(np.prod(e["shape"])) if e["shape"] else 1
-            data[k] = np.frombuffer(buf, dt, cnt,
-                                    e["offset"]).reshape(e["shape"])
-    else:
-        with np.load(os.path.join(d, _ARRAYS)) as npz:
-            data = {k: npz[k] for k in npz.files}
+    manifest, data = _load_manifest_and_data(d, verify=verify)
 
     if shardings is not None and target is not None:
         spec_map = _spec_map(shardings, target)
@@ -389,11 +584,27 @@ def restore_checkpoint(
     # fallback for manifests written before the "path" field existed
     by_path = {tuple(e["path"]): k for k, e in manifest["leaves"].items()
                if "path" in e}
+    # collect ALL missing leaves up front: a target/checkpoint structure
+    # mismatch should name everything wrong with it, not die on the first key
+    missing = []
+    for path, _ in paths:
+        key = by_path.get(tuple(_path_parts(path)), _keystr(path))
+        if key not in manifest["leaves"]:
+            missing.append(key)
+    if missing:
+        present = sorted(manifest["leaves"])
+        shown = ", ".join(repr(k) for k in present[:8])
+        if len(present) > 8:
+            shown += f", ... ({len(present)} total)"
+        raise KeyError(
+            f"checkpoint at {d} is missing {len(missing)} leaves required "
+            f"by the restore target: {missing} — the checkpoint holds "
+            f"[{shown}]. The target's structure does not match what was "
+            "saved (wrong checkpoint dir, or the model/optimizer definition "
+            "changed since the save).")
     leaves = []
     for path, tleaf in paths:
         key = by_path.get(tuple(_path_parts(path)), _keystr(path))
-        if key not in manifest["leaves"]:
-            raise KeyError(f"checkpoint at {d} is missing leaf {key}")
         want = None
         if tleaf is not None and hasattr(tleaf, "dtype"):
             want = tleaf.dtype

@@ -98,6 +98,7 @@ class FP16_Optimizer:
         return {
             "loss_scale": self.scale_state.loss_scale,
             "unskipped": self.scale_state.unskipped,
+            "skipped": self.scale_state.skipped,
             "master_params": self.master_params,
             "opt_state": self.opt_state,
         }
@@ -105,9 +106,11 @@ class FP16_Optimizer:
     def load_state_dict(self, sd):
         from apex_tpu.amp.scaler import LossScaleState
 
+        skipped = sd.get("skipped")  # absent in pre-counter state dicts
         self.scale_state = LossScaleState(
             loss_scale=jnp.asarray(sd["loss_scale"], jnp.float32),
-            unskipped=jnp.asarray(sd["unskipped"], jnp.int32))
+            unskipped=jnp.asarray(sd["unskipped"], jnp.int32),
+            skipped=jnp.asarray(0 if skipped is None else skipped, jnp.int32))
         self.master_params = sd["master_params"]
         self.opt_state = sd["opt_state"]
 

@@ -1,0 +1,65 @@
+"""Training resilience: survive preemption, flaky storage, and divergence.
+
+The hardening layer the 2021 reference never had (its fault story is
+per-rank ``torch.save``, SURVEY §5.4) and every production JAX training
+stack ships:
+
+- **async checkpointing** — ``save_checkpoint(..., blocking=False)``
+  overlaps disk serialization with training; fence-on-next-save/exit
+  semantics, exponential-backoff retry on transient storage errors
+  (:mod:`~apex_tpu.resilience.async_checkpoint`,
+  :class:`~apex_tpu.checkpoint.RetryPolicy`);
+- **integrity** — per-array CRC32 digests in the manifest;
+  :func:`restore_resilient` verifies on load and falls back to the newest
+  intact older checkpoint on corruption
+  (:mod:`~apex_tpu.resilience.restore`);
+- **preemption** — :class:`GracePeriodHandler` turns SIGTERM/SIGINT into a
+  flag the train loop polls at step boundaries: final checkpoint, clean
+  exit (:mod:`~apex_tpu.resilience.preemption`);
+- **divergence guards** — :class:`StepGuard` unifies skip-on-non-finite
+  for amp and non-amp runs and raises a diagnostic naming the first
+  non-finite leaf after K consecutive skips
+  (:mod:`~apex_tpu.resilience.guards`);
+- **fault injection** — :mod:`~apex_tpu.resilience.chaos` reproduces all
+  of the above deterministically on CPU for the test tier (transient write
+  errors, corrupted/truncated array files, simulated preemption).
+
+See ``docs/resilience.md`` for the full semantics (fencing rules,
+retention, multi-host notes).
+"""
+
+from apex_tpu.checkpoint.checkpoint import (  # noqa: F401
+    CheckpointCorruptionError,
+    RetryPolicy,
+    verify_checkpoint,
+)
+from apex_tpu.resilience.async_checkpoint import (  # noqa: F401
+    AsyncSaveError,
+    in_flight,
+    wait_for_save,
+)
+from apex_tpu.resilience.guards import (  # noqa: F401
+    DivergenceError,
+    StepGuard,
+    first_nonfinite_leaf,
+)
+from apex_tpu.resilience.preemption import GracePeriodHandler  # noqa: F401
+from apex_tpu.resilience.restore import (  # noqa: F401
+    CheckpointFallbackWarning,
+    restore_resilient,
+)
+
+__all__ = [
+    "AsyncSaveError",
+    "CheckpointCorruptionError",
+    "CheckpointFallbackWarning",
+    "DivergenceError",
+    "GracePeriodHandler",
+    "RetryPolicy",
+    "StepGuard",
+    "first_nonfinite_leaf",
+    "in_flight",
+    "restore_resilient",
+    "verify_checkpoint",
+    "wait_for_save",
+]

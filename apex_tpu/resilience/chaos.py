@@ -1,0 +1,178 @@
+"""Deterministic fault injection for the resilience test tier.
+
+Everything in :mod:`apex_tpu.resilience` exists to survive conditions a
+unit test never hits naturally — flaky storage, truncated files, SIGTERM
+mid-run.  This module makes those conditions *reproducible on CPU*:
+
+- :class:`FaultyStore` — context manager hooking the checkpoint storage
+  layer (``apex_tpu.checkpoint.checkpoint.set_fault_hook``) to raise
+  transient errors and/or sleep (slow-writer simulation) at named I/O
+  events (``"write_arrays"``, ``"write_manifest"``, ``"commit"``,
+  ``"read_arrays"``);
+- :func:`corrupt_arrays` / :func:`truncate_file` — post-hoc on-disk damage
+  (bit flip inside the stored bytes, or truncation) that restore-side
+  CRC32 verification must catch;
+- :class:`SimulatedPreemption` — delivers a real SIGTERM to this process
+  (or calls ``handler.request_stop()`` off the main thread) after a chosen
+  number of step-boundary polls.
+
+Test-only by design: nothing here is imported by production modules, and
+the hook slot is cleared by the context managers (plus the test harness's
+chaos fixture) even when the simulated crash propagates.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Iterable, Optional
+
+import numpy as np
+
+from apex_tpu.checkpoint import checkpoint as _ckpt
+
+
+class InjectedStorageError(OSError):
+    """The error FaultyStore raises — a subclass of OSError so the default
+    :class:`~apex_tpu.checkpoint.checkpoint.RetryPolicy` treats it as
+    transient/retryable."""
+
+
+class FaultyStore:
+    """Inject failures and latency into checkpoint storage I/O.
+
+    ``fail_events`` — event names that should raise; the first
+    ``fail_times`` matching calls raise :class:`InjectedStorageError`
+    (``fail_times=None`` = always fail).  ``delay`` — seconds to sleep on
+    every matching ``delay_events`` call (slow storage / slow writer).
+    Counts are exposed for assertions: ``calls`` (per event) and
+    ``failures_injected``.
+    """
+
+    def __init__(self, *, fail_events: Iterable[str] = (),
+                 fail_times: Optional[int] = 0,
+                 delay: float = 0.0,
+                 delay_events: Iterable[str] = ("write_arrays",)):
+        self.fail_events = frozenset(fail_events)
+        self.fail_times = fail_times
+        self.delay = delay
+        self.delay_events = frozenset(delay_events)
+        self.calls: dict = {}
+        self.failures_injected = 0
+        self._lock = threading.Lock()
+        self._prev_hook = None
+
+    def _hook(self, event: str, path: str) -> None:
+        with self._lock:
+            self.calls[event] = self.calls.get(event, 0) + 1
+            should_fail = event in self.fail_events and (
+                self.fail_times is None
+                or self.failures_injected < self.fail_times)
+            if should_fail:
+                self.failures_injected += 1
+        if self.delay and event in self.delay_events:
+            time.sleep(self.delay)
+        if should_fail:
+            raise InjectedStorageError(
+                f"injected fault at {event} ({path})")
+
+    def __enter__(self) -> "FaultyStore":
+        self._prev_hook = _ckpt.set_fault_hook(self._hook)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _ckpt.set_fault_hook(self._prev_hook)
+        self._prev_hook = None
+
+
+def slow_writer(delay: float) -> FaultyStore:
+    """A FaultyStore that only slows the arrays write — the knob the
+    async-overlap test turns."""
+    return FaultyStore(delay=delay, delay_events=("write_arrays",))
+
+
+def truncate_file(path: str, keep_bytes: Optional[int] = None) -> None:
+    """Truncate ``path`` (default: to half its size) — the classic
+    crashed-writer artifact."""
+    size = os.path.getsize(path)
+    keep = size // 2 if keep_bytes is None else keep_bytes
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+
+
+def _flip_byte(path: str, off: int) -> None:
+    """Invert the byte at ``off`` in ``path``."""
+    with open(path, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def corrupt_arrays(ckpt_dir: str, step: int, *, mode: str = "flip") -> str:
+    """Damage the stored arrays of checkpoint ``step`` in place.
+
+    ``mode="flip"`` inverts one byte in the middle of the file (caught by
+    CRC32 verification, or by the npz zip CRC); ``mode="truncate"`` cuts
+    the file in half (caught as an unreadable archive / short pack).
+    Returns the damaged file's path."""
+    d = _ckpt.step_dir(ckpt_dir, step)
+    path = os.path.join(d, _ckpt._PACK)
+    if not os.path.exists(path):
+        path = os.path.join(d, _ckpt._ARRAYS)
+    if mode == "truncate":
+        truncate_file(path)
+        return path
+    if mode != "flip":
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    _flip_byte(path, os.path.getsize(path) // 2)
+    return path
+
+
+def flip_packed_leaf_byte(ckpt_dir: str, step: int, key: str) -> None:
+    """Precision strike for the packed format: flip one byte inside leaf
+    ``key``'s stored span, so exactly that leaf's CRC32 check fails."""
+    import json
+
+    d = _ckpt.step_dir(ckpt_dir, step)
+    with open(os.path.join(d, _ckpt._MANIFEST)) as f:
+        entry = json.load(f)["leaves"][key]
+    dt = np.dtype(_ckpt._stored_dtype(entry))
+    nbytes = int(np.prod(entry["shape"] or [1])) * dt.itemsize
+    _flip_byte(os.path.join(d, _ckpt._PACK),
+               entry["offset"] + max(0, nbytes // 2))
+
+
+class SimulatedPreemption:
+    """Deterministically preempt a training loop at a chosen step boundary.
+
+    Call :meth:`poll` once per step (the resilient train loop does this for
+    you via its ``on_step`` hook); on the ``at_poll``-th call it delivers a
+    real ``SIGTERM`` to this process (exercising the actual signal path of
+    :class:`~apex_tpu.resilience.preemption.GracePeriodHandler`) or, when
+    ``use_signal=False`` or off the main thread, calls
+    ``handler.request_stop()`` directly."""
+
+    def __init__(self, at_poll: int, *, handler=None, use_signal: bool = True):
+        self.at_poll = at_poll
+        self.handler = handler
+        self.use_signal = use_signal
+        self.polls = 0
+        self.fired = False
+
+    def poll(self, *_args) -> None:
+        self.polls += 1
+        if self.fired or self.polls < self.at_poll:
+            return
+        self.fired = True
+        if (self.use_signal
+                and threading.current_thread() is threading.main_thread()):
+            os.kill(os.getpid(), signal.SIGTERM)
+        elif self.handler is not None:
+            self.handler.request_stop()
+        else:
+            raise RuntimeError(
+                "SimulatedPreemption off the main thread needs a handler "
+                "to call request_stop() on")

@@ -1,0 +1,108 @@
+"""Divergence guards: skip bad steps, diagnose persistent blow-ups.
+
+The amp loss scaler already implements Megatron/apex-style skip-on-overflow
+(``LossScaler.unscale`` → ``step_if_finite``), but (a) non-amp fp32 runs
+had no equivalent, and (b) nothing ever *stopped* a run that skips forever
+— the reference happily divides its loss scale down to ``min_scale`` and
+keeps burning accelerator time on NaNs.  :class:`StepGuard` unifies both:
+
+    guard = StepGuard(max_consecutive_skips=5)
+    ...
+    finite = guard.check(grads)          # non-amp: fused all-finite reduce
+    # (amp runs instead reuse scaler.unscale's `finite` — same machinery)
+    new_p, new_o = opt.step_if_finite(grads, opt_state, params, finite)
+    guard.update(finite, grads)          # host side: count + diagnose
+
+``update`` raises :class:`DivergenceError` naming the first non-finite
+leaf (path + nan/inf counts) once ``max_consecutive_skips`` consecutive
+steps have been skipped — a diagnostic, not a mystery hang.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from apex_tpu.utils.tree import tree_isfinite
+
+
+class DivergenceError(RuntimeError):
+    """Training skipped too many consecutive steps on non-finite values."""
+
+
+def first_nonfinite_leaf(tree: Any) -> Optional[str]:
+    """Human-readable description of the first leaf containing a non-finite
+    value: ``"['dense']['w']: 3 nan, 1 inf (of 128)"``; None if clean.
+
+    Host-side (device_get per leaf until the culprit is found) — only call
+    on the failure path."""
+    import jax.numpy as jnp
+
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(jax.device_get(leaf))
+        # jnp.issubdtype, not np: bf16 (ml_dtypes) must count as floating
+        if not jnp.issubdtype(arr.dtype, jnp.floating):
+            continue
+        if not np.issubdtype(arr.dtype, np.floating):
+            arr = arr.astype(np.float32)  # bf16/fp8 → np ufunc-friendly
+        finite = np.isfinite(arr)
+        if finite.all():
+            continue
+        nan = int(np.isnan(arr).sum())
+        inf = int((~finite).sum()) - nan
+        return (f"{jax.tree_util.keystr(path)}: {nan} nan, {inf} inf "
+                f"(of {arr.size})")
+    return None
+
+
+@dataclasses.dataclass
+class StepGuard:
+    """Host-side skip-step policy shared by amp and non-amp train loops.
+
+    ``max_consecutive_skips`` — raise :class:`DivergenceError` when this
+    many steps in a row were skipped (0/negative disables raising).
+    The counters are plain Python ints (one host sync per step on the
+    ``finite`` scalar — the same sync the loop's logging already pays)."""
+
+    max_consecutive_skips: int = 8
+    consecutive: int = dataclasses.field(default=0, init=False)
+    total_skipped: int = dataclasses.field(default=0, init=False)
+    total_steps: int = dataclasses.field(default=0, init=False)
+
+    def check(self, tree: Any):
+        """Device-side fused all-finite reduction over ``tree`` (grads or
+        loss).  For amp runs this is redundant — ``scaler.unscale`` already
+        returns ``finite``; feed that to :meth:`update` instead."""
+        return tree_isfinite(tree)
+
+    def update(self, finite, tree: Any = None) -> bool:
+        """Record one step's outcome; returns True if the step was skipped.
+
+        ``tree`` (typically the grads) is only touched on the raise path,
+        to name the first non-finite leaf in the diagnostic."""
+        self.total_steps += 1
+        if bool(finite):
+            self.consecutive = 0
+            return False
+        self.consecutive += 1
+        self.total_skipped += 1
+        if 0 < self.max_consecutive_skips <= self.consecutive:
+            culprit = first_nonfinite_leaf(tree) if tree is not None else None
+            where = f" — first non-finite leaf: {culprit}" if culprit else ""
+            raise DivergenceError(
+                f"{self.consecutive} consecutive steps produced non-finite "
+                f"values ({self.total_skipped}/{self.total_steps} steps "
+                f"skipped so far){where}. The run has diverged; lower the "
+                "learning rate, raise loss-scale min_scale, or restore an "
+                "earlier checkpoint (apex_tpu.resilience.restore_resilient).")
+        return True
+
+    def sync_from_scaler(self, scaler_state) -> None:
+        """Adopt the monotonic ``skipped`` counter a
+        :class:`~apex_tpu.amp.scaler.LossScaleState` carries on device, so
+        amp runs restored from checkpoint keep an accurate total."""
+        if getattr(scaler_state, "skipped", None) is not None:
+            self.total_skipped = int(jax.device_get(scaler_state.skipped))

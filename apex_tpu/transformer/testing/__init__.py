@@ -6,6 +6,10 @@ from apex_tpu.transformer.testing.standalone_bert import (  # noqa: F401
     BertModel,
     bert_model_provider,
 )
+from apex_tpu.transformer.testing.train_loop import (  # noqa: F401
+    LoopResult,
+    run_resilient_training,
+)
 from apex_tpu.transformer.testing.standalone_gpt import (  # noqa: F401
     GPTConfig,
     GPTModel,

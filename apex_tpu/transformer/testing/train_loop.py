@@ -1,0 +1,118 @@
+"""Resilient training-loop harness (testing tier).
+
+A minimal but complete train loop wiring together every piece of
+:mod:`apex_tpu.resilience`: periodic async checkpointing, preemption
+polling with a final blocking save, and divergence guarding.  The chaos
+tier drives this loop under simulated preemption / storage faults to prove
+the full survive-and-resume story on CPU; it is also the reference wiring
+for real entrypoints (``examples/gpt/pretrain_gpt.py`` follows the same
+shape).
+
+Contract: ``step_fn(state, batch) -> (state, finite_or_None)`` where
+``finite`` is the all-finite scalar of the step's grads (or None when the
+loop should not do skip accounting).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterable, Optional
+
+from apex_tpu import checkpoint as ckpt
+from apex_tpu.resilience import wait_for_save
+from apex_tpu.resilience.guards import StepGuard
+from apex_tpu.resilience.preemption import GracePeriodHandler
+
+
+@dataclasses.dataclass
+class LoopResult:
+    state: Any
+    steps_run: int  # steps executed by THIS loop invocation
+    step: int  # global step reached (start_step + steps_run)
+    preempted: bool
+    stop_reason: Optional[str]
+    last_saved_step: Optional[int]
+    skipped_steps: int
+
+
+def run_resilient_training(
+    step_fn: Callable[[Any, Any], tuple],
+    state: Any,
+    batches: Iterable[Any],
+    *,
+    ckpt_dir: Optional[str] = None,
+    save_every: int = 0,
+    keep: Optional[int] = None,
+    async_saves: bool = True,
+    shardings: Any = None,
+    handler: Optional[GracePeriodHandler] = None,
+    guard: Optional[StepGuard] = None,
+    start_step: int = 0,
+    on_step: Optional[Callable[[int], None]] = None,
+) -> LoopResult:
+    """Run ``step_fn`` over ``batches`` with the full resilience wiring.
+
+    - every ``save_every`` steps: checkpoint (async by default — the loop
+      keeps stepping while the write is in flight; the next save fences);
+    - after every step: poll ``handler.should_stop``; on preemption write a
+      final BLOCKING checkpoint (itself fencing any in-flight async write)
+      and return with ``preempted=True`` — the caller restarts later via
+      :func:`apex_tpu.resilience.restore_resilient` and passes the
+      remaining batches with ``start_step`` set;
+    - ``guard`` counts skipped steps from the ``finite`` flag ``step_fn``
+      returns and raises after too many consecutive skips;
+    - ``on_step(step)`` runs at each step boundary *before* the preemption
+      poll (the chaos harness's ``SimulatedPreemption.poll`` hooks here);
+    - before returning (any path) the loop fences on outstanding async
+      writes, so a completed run's checkpoints are durable.
+    """
+    step = start_step
+    steps_run = 0
+    last_saved: Optional[int] = None
+    preempted = False
+
+    def _save(blocking: bool) -> None:
+        nonlocal last_saved
+        if ckpt_dir is None:
+            return
+        ckpt.save_checkpoint(ckpt_dir, state, step=step, keep=keep,
+                             shardings=shardings,
+                             blocking=blocking or not async_saves)
+        last_saved = step
+
+    try:
+        for batch in batches:
+            state, finite = step_fn(state, batch)
+            step += 1
+            steps_run += 1
+            if guard is not None and finite is not None:
+                guard.update(finite)
+            if on_step is not None:
+                on_step(step)
+            if handler is not None and handler.should_stop:
+                # grace period: current step finished; make the work durable
+                # and hand control back for a clean exit
+                preempted = True
+                _save(blocking=True)
+                break
+            if save_every and step % save_every == 0:
+                _save(blocking=False)
+    except BaseException:
+        # still fence, but never let a parked async-save error mask the
+        # primary exception (e.g. a DivergenceError diagnostic)
+        try:
+            wait_for_save()
+        except Exception:
+            pass
+        raise
+    wait_for_save()
+
+    return LoopResult(
+        state=state,
+        steps_run=steps_run,
+        step=step,
+        preempted=preempted,
+        stop_reason=handler.reason if handler is not None else None,
+        last_saved_step=last_saved,
+        skipped_steps=guard.total_skipped if guard is not None else 0,
+    )

@@ -45,6 +45,7 @@ from jax.sharding import PartitionSpec as P  # noqa: E402
 
 from apex_tpu import checkpoint as ckpt  # noqa: E402
 from apex_tpu import multi_tensor, optimizers  # noqa: E402
+from apex_tpu import resilience  # noqa: E402
 from apex_tpu.transformer import parallel_state  # noqa: E402
 from apex_tpu.transformer.testing import GPTConfig, GPTModel  # noqa: E402
 from apex_tpu.transformer.testing.arguments import parse_args  # noqa: E402
@@ -148,7 +149,9 @@ def main(argv=None):
     clip = args.clip_grad if args.clip_grad and args.clip_grad > 0 else None
     step0 = 0
     if args.load:
-        (params, opt_state), step0 = ckpt.restore_checkpoint(
+        # CRC-verified restore; a corrupt latest checkpoint (killed
+        # mid-incident) falls back to the newest intact older one
+        (params, opt_state), step0 = resilience.restore_resilient(
             args.load, target=(params, opt_state))
         print(f"resumed from step {step0}")
 
@@ -190,25 +193,46 @@ def main(argv=None):
         next(batches)  # a resumed run must not re-see consumed batches
     t0 = time.perf_counter()
     loss = None
-    for it in range(step0, args.train_iters):
-        tokens, labels = next(batches)
-        rng = jax.random.fold_in(jax.random.PRNGKey(args.seed + 2), it)
-        params, opt_state, loss = train_step(params, opt_state, tokens,
-                                             labels, rng)
-        if (it + 1) % args.log_interval == 0:
-            dt = (time.perf_counter() - t0) / args.log_interval
-            tok_s = args.global_batch_size * args.seq_length / dt
-            print(f"iter {it + 1}/{args.train_iters} "
-                  f"loss {float(loss):.4f} {dt * 1e3:.0f} ms/iter "
-                  f"{tok_s:,.0f} tok/s", flush=True)
-            t0 = time.perf_counter()
-        if args.save and args.save_interval and \
-                (it + 1) % args.save_interval == 0:
-            ckpt.save_checkpoint(args.save, (params, opt_state), step=it + 1)
-    if args.save and not (args.save_interval
-                          and args.train_iters % args.save_interval == 0):
+    preempted = False
+    with resilience.GracePeriodHandler() as preempt:
+        for it in range(step0, args.train_iters):
+            tokens, labels = next(batches)
+            rng = jax.random.fold_in(jax.random.PRNGKey(args.seed + 2), it)
+            params, opt_state, loss = train_step(params, opt_state, tokens,
+                                                 labels, rng)
+            if (it + 1) % args.log_interval == 0:
+                dt = (time.perf_counter() - t0) / args.log_interval
+                tok_s = args.global_batch_size * args.seq_length / dt
+                print(f"iter {it + 1}/{args.train_iters} "
+                      f"loss {float(loss):.4f} {dt * 1e3:.0f} ms/iter "
+                      f"{tok_s:,.0f} tok/s", flush=True)
+                t0 = time.perf_counter()
+            if preempt.should_stop:
+                # grace period: make the finished step durable, exit clean
+                preempted = True
+                if args.save:
+                    ckpt.save_checkpoint(args.save, (params, opt_state),
+                                         step=it + 1)
+                outcome = ("checkpoint written" if args.save
+                           else "no --save dir, progress lost")
+                print(f"preempted ({preempt.reason}) at iter {it + 1}: "
+                      f"{outcome}, exiting", flush=True)
+                break
+            if args.save and args.save_interval and \
+                    (it + 1) % args.save_interval == 0:
+                # async: the write overlaps the next training steps and the
+                # next save (or exit) fences on it
+                ckpt.save_checkpoint(args.save, (params, opt_state),
+                                     step=it + 1, blocking=False)
+    if args.save and not preempted and not (
+            args.save_interval
+            and args.train_iters % args.save_interval == 0):
         ckpt.save_checkpoint(args.save, (params, opt_state),
                              step=args.train_iters)
+    resilience.wait_for_save()
+    if preempted:
+        parallel_state.destroy_model_parallel()
+        return float(loss) if loss is not None else None
     assert loss is not None and bool(jnp.isfinite(loss)), "diverged"
     print(f"done: final loss {float(loss):.4f}")
     parallel_state.destroy_model_parallel()

@@ -120,6 +120,50 @@ class TestLossScaler:
         st2 = amp.load_state_dict(d)
         assert float(st2.loss_scale) == float(st.loss_scale)
         assert int(st2.unskipped) == 1
+        assert int(st2.skipped) == 0
+
+    def test_min_scale_clamps_repeated_overflow(self):
+        # custom (non-default) floor, starting well above it: repeated
+        # overflows divide down then stick at min_scale exactly
+        s = amp.LossScaler.dynamic_scaler(init_scale=32.0, min_scale=8.0)
+        st = s.init()
+        for expect in (16.0, 8.0, 8.0, 8.0):
+            st = s.update(st, False)
+            assert float(st.loss_scale) == expect
+
+    def test_max_scale_clamps_growth(self):
+        # growth would overshoot a custom cap: 6 -> would be 12, capped at 10
+        s = amp.LossScaler.dynamic_scaler(
+            init_scale=6.0, scale_window=1, max_scale=10.0)
+        st = s.update(s.init(), True)
+        assert float(st.loss_scale) == 10.0
+        st = s.update(st, True)
+        assert float(st.loss_scale) == 10.0  # stays clamped
+
+    def test_skipped_counter_monotonic(self):
+        """`skipped` counts every overflow-skipped step and never resets —
+        the queryable version of the reference's "Gradient overflow.
+        Skipping step" print (used by resilience.StepGuard)."""
+        s = amp.LossScaler.dynamic_scaler(init_scale=16.0)
+        st = s.init()
+        assert int(st.skipped) == 0
+        seq = [False, True, False, False, True]
+        for finite in seq:
+            st = s.update(st, finite)
+        assert int(st.skipped) == 3
+        # clean steps never decrease it
+        st = s.update(st, True)
+        assert int(st.skipped) == 3
+
+    def test_skipped_counts_under_static_scaler_too(self):
+        s = amp.LossScaler.static(128.0)
+        st = s.update(s.init(), False)
+        assert float(st.loss_scale) == 128.0  # scale pinned
+        assert int(st.skipped) == 1  # but the skip is still recorded
+
+    def test_load_state_dict_without_skipped_key(self):
+        st = amp.load_state_dict({"loss_scale": 4.0, "unskipped": 7})
+        assert int(st.skipped) == 0
 
 
 class TestScaledValueAndGrad:

@@ -235,6 +235,59 @@ def test_missing_leaf_errors(tmp_path):
                                                        "y": jnp.zeros((2,))})
 
 
+def test_missing_leaf_error_lists_all_missing_keys(tmp_path):
+    """A target/checkpoint mismatch names EVERY missing leaf plus what the
+    checkpoint actually holds — not a bare KeyError on the first key."""
+    ckpt.save_checkpoint(str(tmp_path), {"x": jnp.zeros((2,))}, step=0)
+    target = {"x": jnp.zeros((2,)), "y": jnp.zeros((2,)), "z": jnp.zeros((3,))}
+    with pytest.raises(KeyError) as ei:
+        ckpt.restore_checkpoint(str(tmp_path), target=target)
+    msg = ei.value.args[0]  # str(KeyError) repr-escapes the quoted keys
+    assert "missing 2 leaves" in msg
+    assert "['y']" in msg and "['z']" in msg
+    assert "['x']" in msg  # ...and says what IS there
+
+
+def test_malformed_step_names_ignored(tmp_path):
+    """Scanning tolerates every crash/user artifact: tmp dirs, non-digit
+    suffixes, int()-parseable-but-nonstandard names ("+3", "1_0"), and
+    plain files named like steps."""
+    import os
+    tree = {"x": jnp.zeros((2,))}
+    ckpt.save_checkpoint(str(tmp_path), tree, step=4)
+    os.makedirs(tmp_path / "step_0000000009.tmp")
+    (tmp_path / "step_0000000009.tmp" / "manifest.json").write_text("{}")
+    for bad in ("step_+3", "step_1_0", "step_ 7", "step_junk", "step_",
+                "step_³", "step_٣"):  # non-ASCII "digits"
+        os.makedirs(tmp_path / bad)
+        (tmp_path / bad / "manifest.json").write_text("{}")
+    (tmp_path / "step_0000000012").write_text("a file, not a dir")
+    (tmp_path / "latest").write_text("12")  # marker points at the junk file
+    assert ckpt.latest_step(str(tmp_path)) == 4
+    restored, step = ckpt.restore_checkpoint(str(tmp_path), target=tree)
+    assert step == 4
+
+
+def test_multi_checkpoint_corrupt_latest_falls_back(tmp_path):
+    """Satellite acceptance: save steps N<M, corrupt M's arrays file —
+    resilient restore falls back to N and reports the corruption."""
+    from apex_tpu import resilience as res
+    from apex_tpu.resilience import chaos
+
+    ckpt.save_checkpoint(str(tmp_path), {"x": jnp.ones((4,)) * 1}, step=3)
+    ckpt.save_checkpoint(str(tmp_path), {"x": jnp.ones((4,)) * 2}, step=8)
+    chaos.corrupt_arrays(str(tmp_path), 8, mode="flip")
+    # plain restore of the corrupt step with verify=True refuses
+    with pytest.raises(ckpt.CheckpointCorruptionError):
+        ckpt.restore_checkpoint(str(tmp_path), target={"x": jnp.zeros((4,))},
+                                step=8, verify=True)
+    with pytest.warns(res.CheckpointFallbackWarning):
+        restored, step = res.restore_resilient(
+            str(tmp_path), target={"x": jnp.zeros((4,))})
+    assert step == 3
+    np.testing.assert_array_equal(np.asarray(restored["x"]), np.ones(4))
+
+
 def test_packed_format_round_trip_exact(tmp_path):
     """format 2: one flat superblock file written via the native threaded
     pack (apex_C-parity host runtime) — bitwise equal restore, including

@@ -1,0 +1,497 @@
+"""Resilience subsystem tests: async checkpointing, preemption, divergence
+guards, and integrity verification — all exercised deterministically on CPU
+via the chaos fault-injection harness (apex_tpu.resilience.chaos).
+
+The reference has nothing to match here (its fault story is per-rank
+torch.save, SURVEY §5.4); these tests define the contract of the hardening
+layer instead: a training run survives simulated preemption and resumes
+bit-identically, a corrupted latest checkpoint falls back to the previous
+intact one, and async saves overlap the step loop with fence-on-next-save
+semantics.
+"""
+
+import os
+import signal
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu import checkpoint as ckpt
+from apex_tpu import resilience as res
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.resilience import chaos
+from apex_tpu.transformer.testing import run_resilient_training
+
+pytestmark = pytest.mark.chaos
+
+
+# ---------------------------------------------------------------- helpers
+
+
+def _toy_state():
+    k = jax.random.PRNGKey(0)
+    params = {"dense": {"w": jax.random.normal(k, (4, 4), jnp.float32),
+                        "b": jnp.zeros((4,), jnp.float32)}}
+    opt = FusedAdam(lr=1e-2)
+    scaler = amp.initialize("O2").scaler
+    state = ckpt.TrainState.create(params, opt.init(params), scaler.init())
+    return state, opt, scaler
+
+
+def _make_step_fn(opt, scaler):
+    @jax.jit
+    def train_step(state, xy):
+        x, y = xy
+        def loss(p):
+            pred = x @ p["dense"]["w"] + p["dense"]["b"]
+            return scaler.scale(jnp.mean((pred - y) ** 2), state.scaler_state)
+
+        grads = jax.grad(loss)(state.params)
+        grads, finite = scaler.unscale(grads, state.scaler_state)
+        new_p, new_o = opt.step_if_finite(grads, state.opt_state,
+                                          state.params, finite)
+        return state.replace(
+            step=state.step + 1, params=new_p, opt_state=new_o,
+            scaler_state=scaler.update(state.scaler_state, finite)), finite
+
+    return lambda s, b: train_step(s, b)
+
+
+def _batches(n, key=jax.random.PRNGKey(3)):
+    out = []
+    for i in range(n):
+        k = jax.random.fold_in(key, i)
+        out.append((jax.random.normal(k, (8, 4), jnp.float32),
+                    jax.random.normal(jax.random.fold_in(k, 1), (8, 4),
+                                      jnp.float32)))
+    return out
+
+
+def _assert_trees_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ----------------------------------------------------- async checkpointing
+
+
+def test_async_save_overlaps_training(chaos_ckpt_dir):
+    """The acceptance case: with a slow injected writer in flight, the step
+    loop keeps advancing; the fence then blocks until the write lands and
+    the checkpoint restores intact."""
+    state, opt, scaler = _toy_state()
+    step_fn = _make_step_fn(opt, scaler)
+    batches = _batches(4)
+    # warm the jit cache so steps during the write are fast
+    state2, _ = step_fn(state, batches[0])
+
+    with chaos.slow_writer(0.5):
+        ckpt.save_checkpoint(str(chaos_ckpt_dir), state2, step=1,
+                             blocking=False)
+        assert res.in_flight()
+        steps_while_writing = 0
+        s = state2
+        for b in batches[1:]:
+            s, _ = step_fn(s, b)
+            if res.in_flight():
+                steps_while_writing += 1
+        # the loop made progress while the writer slept
+        assert steps_while_writing > 0
+        res.wait_for_save()  # the fence
+    assert not res.in_flight()
+    assert ckpt.latest_step(str(chaos_ckpt_dir)) == 1
+    restored, _ = ckpt.restore_checkpoint(str(chaos_ckpt_dir), target=state2,
+                                          verify=True)
+    _assert_trees_equal(state2, restored)
+
+
+def test_next_save_fences_on_in_flight_write(chaos_ckpt_dir):
+    """A second save — async or blocking — must wait for the first write to
+    complete (at most one write in flight)."""
+    tree = {"w": jnp.arange(16, dtype=jnp.float32)}
+    t0 = time.perf_counter()
+    with chaos.slow_writer(0.4):
+        ckpt.save_checkpoint(str(chaos_ckpt_dir), tree, step=1,
+                             blocking=False)
+        # this save fences on step 1's slow write AND is itself slow
+        ckpt.save_checkpoint(str(chaos_ckpt_dir), tree, step=2)
+    elapsed = time.perf_counter() - t0
+    assert elapsed >= 0.8  # both writes serialized, neither skipped
+    assert ckpt.latest_step(str(chaos_ckpt_dir)) == 2
+    assert ckpt.verify_checkpoint(str(chaos_ckpt_dir), 1) == 1
+    assert ckpt.verify_checkpoint(str(chaos_ckpt_dir), 2) == 2
+
+
+def test_async_write_failure_surfaces_at_fence(chaos_ckpt_dir):
+    """A background write that exhausts its retries parks the error; the
+    next fence raises it (never silently dropped)."""
+    tree = {"w": jnp.zeros((4,))}
+    with chaos.FaultyStore(fail_events=("write_arrays",), fail_times=None):
+        ckpt.save_checkpoint(
+            str(chaos_ckpt_dir), tree, step=1, blocking=False,
+            retry=ckpt.RetryPolicy(max_attempts=2, base_delay=0.01))
+        with pytest.raises(res.AsyncSaveError) as ei:
+            res.wait_for_save()
+    assert "injected fault" in str(ei.value.__cause__)
+    # the error was consumed: the writer is reusable afterwards
+    ckpt.save_checkpoint(str(chaos_ckpt_dir), tree, step=2, blocking=False)
+    res.wait_for_save()
+    assert ckpt.latest_step(str(chaos_ckpt_dir)) == 2
+
+
+def test_retry_recovers_from_transient_write_errors(chaos_ckpt_dir):
+    """First two attempts hit injected storage errors; the third lands.
+    No partial state survives (each attempt rewrites the tmp dir)."""
+    tree = {"w": jnp.arange(8, dtype=jnp.float32)}
+    with chaos.FaultyStore(fail_events=("write_arrays",),
+                           fail_times=2) as store:
+        ckpt.save_checkpoint(
+            str(chaos_ckpt_dir), tree, step=3,
+            retry=ckpt.RetryPolicy(max_attempts=3, base_delay=0.01))
+    assert store.failures_injected == 2
+    assert store.calls["write_arrays"] == 3
+    assert ckpt.verify_checkpoint(str(chaos_ckpt_dir), 3) == 3
+    leftovers = [n for n in os.listdir(chaos_ckpt_dir) if n.endswith(".tmp")]
+    assert leftovers == []
+
+
+def test_retry_exhaustion_raises_and_leaves_no_partial(chaos_ckpt_dir):
+    tree = {"w": jnp.zeros((2,))}
+    with chaos.FaultyStore(fail_events=("commit",), fail_times=None):
+        with pytest.raises(OSError):
+            ckpt.save_checkpoint(
+                str(chaos_ckpt_dir), tree, step=1,
+                retry=ckpt.RetryPolicy(max_attempts=2, base_delay=0.01))
+    assert ckpt.latest_step(str(chaos_ckpt_dir)) is None
+
+
+# ------------------------------------------------------ integrity / verify
+
+
+def test_crc32_digests_recorded_per_leaf(chaos_ckpt_dir):
+    import json
+    import zlib
+
+    tree = {"w": jnp.arange(6, dtype=jnp.float32),
+            "h": jnp.ones((3,), jnp.bfloat16)}
+    ckpt.save_checkpoint(str(chaos_ckpt_dir), tree, step=0)
+    with open(os.path.join(ckpt.step_dir(str(chaos_ckpt_dir), 0),
+                           "manifest.json")) as f:
+        man = json.load(f)
+    assert all("crc32" in e for e in man["leaves"].values())
+    # the digest is over the bytes as STORED (bf16 leaf stored fp32)
+    want = zlib.crc32(
+        np.asarray(tree["h"], dtype=np.float32).tobytes()) & 0xFFFFFFFF
+    assert man["leaves"]["['h']"]["crc32"] == want
+
+
+def test_verify_detects_flipped_byte_npz(chaos_ckpt_dir):
+    ckpt.save_checkpoint(str(chaos_ckpt_dir),
+                         {"w": jnp.arange(64, dtype=jnp.float32)}, step=1)
+    assert ckpt.verify_checkpoint(str(chaos_ckpt_dir)) == 1
+    chaos.corrupt_arrays(str(chaos_ckpt_dir), 1, mode="flip")
+    with pytest.raises(ckpt.CheckpointCorruptionError):
+        ckpt.verify_checkpoint(str(chaos_ckpt_dir))
+
+
+def test_verify_detects_exact_leaf_in_packed(chaos_ckpt_dir):
+    """Packed superblock has no zip CRC safety net — our per-leaf digest is
+    the only integrity check, and it names the damaged leaf."""
+    tree = {"a": jnp.arange(32, dtype=jnp.float32),
+            "b": jnp.ones((32,), jnp.float32)}
+    ckpt.save_checkpoint(str(chaos_ckpt_dir), tree, step=2, packed=True)
+    chaos.flip_packed_leaf_byte(str(chaos_ckpt_dir), 2, "['b']")
+    with pytest.raises(ckpt.CheckpointCorruptionError) as ei:
+        ckpt.verify_checkpoint(str(chaos_ckpt_dir), 2)
+    assert "['b']" in str(ei.value) and "['a']" not in str(ei.value)
+
+
+def test_verify_detects_truncation(chaos_ckpt_dir):
+    ckpt.save_checkpoint(str(chaos_ckpt_dir),
+                         {"w": jnp.arange(256, dtype=jnp.float32)}, step=1,
+                         packed=True)
+    chaos.corrupt_arrays(str(chaos_ckpt_dir), 1, mode="truncate")
+    with pytest.raises(ckpt.CheckpointCorruptionError):
+        ckpt.verify_checkpoint(str(chaos_ckpt_dir), 1)
+
+
+def test_restore_falls_back_to_newest_intact(chaos_ckpt_dir):
+    """Acceptance case: steps N<M on disk, M's arrays corrupted — restore
+    lands on N and reports the corruption via CheckpointFallbackWarning."""
+    tree = {"w": jnp.arange(16, dtype=jnp.float32)}
+    ckpt.save_checkpoint(str(chaos_ckpt_dir),
+                         jax.tree_util.tree_map(lambda x: x * 2, tree),
+                         step=5)
+    ckpt.save_checkpoint(str(chaos_ckpt_dir),
+                         jax.tree_util.tree_map(lambda x: x * 3, tree),
+                         step=9)
+    chaos.corrupt_arrays(str(chaos_ckpt_dir), 9, mode="flip")
+    with pytest.warns(res.CheckpointFallbackWarning) as record:
+        restored, step = res.restore_resilient(str(chaos_ckpt_dir),
+                                               target=tree)
+    assert any("step 9" in str(w.message) for w in record)
+    assert step == 5
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.arange(16, dtype=np.float32) * 2)
+
+
+def test_restore_resilient_all_corrupt_raises(chaos_ckpt_dir):
+    tree = {"w": jnp.arange(8, dtype=jnp.float32)}
+    for s in (1, 2):
+        ckpt.save_checkpoint(str(chaos_ckpt_dir), tree, step=s)
+        chaos.corrupt_arrays(str(chaos_ckpt_dir), s, mode="flip")
+    with pytest.warns(res.CheckpointFallbackWarning):
+        with pytest.raises(ckpt.CheckpointCorruptionError,
+                           match="no intact checkpoint"):
+            res.restore_resilient(str(chaos_ckpt_dir), target=tree)
+
+
+def test_restore_resilient_structure_mismatch_is_not_corruption(
+        chaos_ckpt_dir):
+    """A target/checkpoint structure mismatch must raise immediately (every
+    older checkpoint would fail identically), not walk the history."""
+    tree = {"w": jnp.zeros((2,))}
+    ckpt.save_checkpoint(str(chaos_ckpt_dir), tree, step=1)
+    ckpt.save_checkpoint(str(chaos_ckpt_dir), tree, step=2)
+    with pytest.raises(KeyError, match="missing 1 leaves"):
+        res.restore_resilient(str(chaos_ckpt_dir),
+                              target={"w": jnp.zeros((2,)),
+                                      "extra": jnp.zeros((2,))})
+
+
+def test_restore_resilient_honors_rollback_recency(chaos_ckpt_dir):
+    """A rollback-resume writes a LOWER step more recently than a higher
+    one still on disk; the resilient walk must start from the marker/most
+    recent write, not resurrect the rolled-back higher step."""
+    tree10 = {"w": jnp.ones((4,)) * 10}
+    tree8 = {"w": jnp.ones((4,)) * 8}
+    ckpt.save_checkpoint(str(chaos_ckpt_dir), tree10, step=10)
+    ckpt.save_checkpoint(str(chaos_ckpt_dir), tree8, step=8)  # rollback
+    assert ckpt.latest_step(str(chaos_ckpt_dir)) == 8
+    restored, step = res.restore_resilient(str(chaos_ckpt_dir),
+                                           target={"w": jnp.zeros((4,))})
+    assert step == 8
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.ones(4) * 8)
+
+
+def test_injected_read_fault_triggers_fallback(chaos_ckpt_dir):
+    """A read-side storage fault on the newest checkpoint counts as
+    corruption under verification and falls back like damaged bytes do."""
+    tree = {"w": jnp.ones((4,))}
+    ckpt.save_checkpoint(str(chaos_ckpt_dir), tree, step=1)
+    ckpt.save_checkpoint(str(chaos_ckpt_dir), tree, step=2)
+    with chaos.FaultyStore(fail_events=("read_arrays",), fail_times=1):
+        with pytest.warns(res.CheckpointFallbackWarning):
+            _, step = res.restore_resilient(str(chaos_ckpt_dir), target=tree)
+    assert step == 1
+
+
+def test_legacy_two_leaf_scaler_state_round_trips(chaos_ckpt_dir):
+    """A checkpoint written before LossScaleState.skipped existed (2-leaf
+    scaler state) restores into a skipped=None target, and update() keeps
+    the legacy treedef stable instead of growing a third leaf mid-train."""
+    from apex_tpu.amp.scaler import LossScaleState, LossScaler
+
+    legacy = LossScaleState(loss_scale=jnp.asarray(128.0, jnp.float32),
+                            unskipped=jnp.asarray(5, jnp.int32))
+    assert len(jax.tree_util.tree_leaves(legacy)) == 2
+    ckpt.save_checkpoint(str(chaos_ckpt_dir), {"scaler": legacy}, step=1)
+    back, _ = ckpt.restore_checkpoint(str(chaos_ckpt_dir),
+                                      target={"scaler": legacy}, verify=True)
+    assert float(back["scaler"].loss_scale) == 128.0
+
+    s = LossScaler.dynamic_scaler()
+    stepped = s.update(back["scaler"], jnp.asarray(False))
+    assert stepped.skipped is None  # treedef unchanged
+    assert (jax.tree_util.tree_structure(stepped)
+            == jax.tree_util.tree_structure(legacy))
+
+
+# ------------------------------------------------------------- preemption
+
+
+def test_grace_period_handler_catches_sigterm():
+    with res.GracePeriodHandler() as h:
+        assert not h.should_stop
+        os.kill(os.getpid(), signal.SIGTERM)
+        # signal delivery is synchronous for a self-kill on the main thread
+        assert h.wait(timeout=5.0)
+        assert h.should_stop
+        assert h.reason == "SIGTERM"
+    # handlers restored on exit
+    assert signal.getsignal(signal.SIGTERM) is not h._on_signal
+
+
+def test_grace_period_handler_restores_previous_handler():
+    prev = signal.getsignal(signal.SIGINT)
+    with res.GracePeriodHandler(signals=(signal.SIGINT,)):
+        assert signal.getsignal(signal.SIGINT) is not prev
+    assert signal.getsignal(signal.SIGINT) is prev
+
+
+def test_request_stop_and_reset():
+    h = res.GracePeriodHandler()
+    h.request_stop()
+    assert h.should_stop and h.reason == "requested"
+    h.reset()
+    assert not h.should_stop and h.reason is None
+
+
+def test_preempted_training_resumes_bit_identical(chaos_ckpt_dir):
+    """THE end-to-end chaos acceptance test: a run receives a simulated
+    preemption (real SIGTERM) mid-run, writes a final checkpoint, exits
+    cleanly; a restarted run restores and finishes with params bit-identical
+    to an uninterrupted run."""
+    state, opt, scaler = _toy_state()
+    step_fn = _make_step_fn(opt, scaler)
+    batches = _batches(6)
+
+    straight = run_resilient_training(step_fn, state, batches)
+    assert straight.steps_run == 6 and not straight.preempted
+
+    with res.GracePeriodHandler() as h:
+        preempt = chaos.SimulatedPreemption(3, handler=h)
+        first = run_resilient_training(
+            step_fn, state, batches, ckpt_dir=str(chaos_ckpt_dir),
+            save_every=2, handler=h, on_step=preempt.poll)
+    assert first.preempted and first.stop_reason == "SIGTERM"
+    assert first.steps_run == 3
+    # the final (grace-period) checkpoint is the one at the stop step
+    assert first.last_saved_step == 3
+    assert ckpt.latest_step(str(chaos_ckpt_dir)) == 3
+
+    # "restart": fresh restore, consume the remaining batches
+    restored, start = res.restore_resilient(str(chaos_ckpt_dir),
+                                            target=state)
+    assert start == 3
+    second = run_resilient_training(step_fn, restored, batches[start:],
+                                    start_step=start)
+    assert second.step == 6
+    _assert_trees_equal(straight.state, second.state)
+
+
+def test_preemption_with_corrupt_final_falls_back_one_save(chaos_ckpt_dir):
+    """Preempt, then corrupt the final checkpoint: the restart falls back
+    to the periodic save and replays from there — still bit-identical."""
+    state, opt, scaler = _toy_state()
+    step_fn = _make_step_fn(opt, scaler)
+    batches = _batches(6)
+    straight = run_resilient_training(step_fn, state, batches)
+
+    with res.GracePeriodHandler() as h:
+        preempt = chaos.SimulatedPreemption(3, handler=h)
+        run_resilient_training(
+            step_fn, state, batches, ckpt_dir=str(chaos_ckpt_dir),
+            save_every=2, handler=h, on_step=preempt.poll)
+    chaos.corrupt_arrays(str(chaos_ckpt_dir), 3, mode="flip")
+    with pytest.warns(res.CheckpointFallbackWarning):
+        restored, start = res.restore_resilient(str(chaos_ckpt_dir),
+                                                target=state)
+    assert start == 2  # the periodic async save
+    second = run_resilient_training(step_fn, restored, batches[start:],
+                                    start_step=start)
+    _assert_trees_equal(straight.state, second.state)
+
+
+# ------------------------------------------------------- divergence guards
+
+
+def test_step_guard_skips_then_raises_with_diagnostic():
+    guard = res.StepGuard(max_consecutive_skips=3)
+    bad_grads = {"dense": {"w": jnp.array([1.0, jnp.nan, jnp.inf, 2.0])}}
+    assert not bool(guard.check(bad_grads))
+    assert guard.update(False, bad_grads) is True
+    assert guard.update(False, bad_grads) is True
+    with pytest.raises(res.DivergenceError) as ei:
+        guard.update(False, bad_grads)
+    msg = str(ei.value)
+    assert "3 consecutive" in msg
+    assert "['dense']['w']" in msg  # names the first non-finite leaf
+    assert "1 nan" in msg and "1 inf" in msg
+
+
+def test_step_guard_resets_on_finite_step():
+    guard = res.StepGuard(max_consecutive_skips=2)
+    guard.update(False)
+    guard.update(True)
+    guard.update(False)  # would raise if the counter had not reset
+    assert guard.consecutive == 1
+    assert guard.total_skipped == 2
+    assert guard.total_steps == 3
+
+
+def test_step_guard_nonamp_loop_skips_bad_step():
+    """Non-amp fp32 run: the guard's own all-finite check drives
+    step_if_finite — params untouched on the poisoned step, updated on the
+    clean one (the unification the amp scaler already had)."""
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    opt = FusedAdam(lr=0.1)
+    opt_state = opt.init(params)
+    guard = res.StepGuard(max_consecutive_skips=5)
+
+    @jax.jit
+    def step(params, opt_state, grads):
+        finite = guard.check(grads)
+        new_p, new_o = opt.step_if_finite(grads, opt_state, params, finite)
+        return new_p, new_o, finite
+
+    bad = {"w": jnp.full((4,), jnp.nan)}
+    p1, o1, f1 = step(params, opt_state, bad)
+    assert guard.update(f1, bad) is True
+    np.testing.assert_array_equal(np.asarray(p1["w"]), np.ones(4))
+
+    good = {"w": jnp.ones((4,), jnp.float32)}
+    p2, o2, f2 = step(p1, o1, good)
+    assert guard.update(f2) is False
+    assert not np.array_equal(np.asarray(p2["w"]), np.ones(4))
+    assert guard.total_skipped == 1
+
+
+def test_step_guard_sync_from_scaler():
+    _, _, scaler = _toy_state()
+    s = scaler.init()
+    s = scaler.update(s, jnp.asarray(False))
+    s = scaler.update(s, jnp.asarray(False))
+    guard = res.StepGuard()
+    guard.sync_from_scaler(s)
+    assert guard.total_skipped == 2
+
+
+def test_first_nonfinite_leaf_clean_tree():
+    assert res.first_nonfinite_leaf({"a": jnp.ones((3,))}) is None
+
+
+def test_loop_exception_not_masked_by_failed_async_save(chaos_ckpt_dir):
+    """A parked async-save failure must not replace the primary exception
+    (the DivergenceError diagnostic) raised from the loop body."""
+    state, opt, scaler = _toy_state()
+    # step 1: skip counted, async save submitted (fails, error parked);
+    # step 2: guard raises — the fence must not swap in AsyncSaveError
+    guard = res.StepGuard(max_consecutive_skips=2)
+
+    def poisoned_step(s, b):
+        return s, jnp.asarray(False)  # every step "non-finite"
+
+    with chaos.FaultyStore(fail_events=("write_arrays",), fail_times=None):
+        with pytest.raises(res.DivergenceError):
+            run_resilient_training(
+                poisoned_step, state, _batches(4),
+                ckpt_dir=str(chaos_ckpt_dir), save_every=1, guard=guard,
+                )
+
+
+# ------------------------------------------------------------ housekeeping
+
+
+def test_fault_hook_cleared_after_context():
+    from apex_tpu.checkpoint import checkpoint as ckpt_mod
+
+    with chaos.FaultyStore(fail_events=("write_arrays",), fail_times=1):
+        pass
+    assert ckpt_mod._fault_hook is None

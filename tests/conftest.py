@@ -24,3 +24,30 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import shutil  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def chaos_ckpt_dir(tmp_path):
+    """Checkpoint dir for fault-injection tests, with crash-proof teardown.
+
+    Chaos tests deliberately leave the checkpoint layer mid-operation
+    (simulated preemption, injected write failures).  This fixture
+    guarantees that no matter how the test ends: (1) any installed storage
+    fault hook is cleared, (2) the background writer is drained with parked
+    errors swallowed (one test's injected failure must not surface at the
+    next test's fence), and (3) the directory — including ``.tmp`` crash
+    artifacts — is removed."""
+    d = tmp_path / "ckpt"
+    try:
+        yield d
+    finally:
+        from apex_tpu.checkpoint import checkpoint as _ckpt_mod
+        from apex_tpu.resilience import async_checkpoint as _async
+
+        _ckpt_mod.set_fault_hook(None)
+        _async.drain(ignore_errors=True)
+        shutil.rmtree(d, ignore_errors=True)
