@@ -29,6 +29,27 @@ TPU mapping — the communication pattern survives, the machinery dissolves:
 Must run inside a region binding ``axis_name`` (shard_map over the mesh).
 Optimizer state lives ONLY for this rank's shard — memory per device is
 ``params + 2·params/N`` instead of ``3·params`` (the ZeRO claim).
+
+Memory-fit knobs (r6, the GPT-1.3B flagship — ISSUE 2): at 1.3B params a
+16 GB chip cannot hold fp32 p+g+m+v (21 GB), so the flat-buffer dtypes
+are configurable the way the reference's are:
+
+* ``scatter_dtype`` — the flat grad buffer / reduce-scatter transport
+  (the reference reduce-scatters its fp16 flat grad buffer,
+  distributed_fused_adam.py:316-362); ``None`` keeps fp32.
+* ``gather_dtype`` — the updated-shard all_gather transport; ``None``
+  keeps fp32.  With bf16 model params, gathering in bf16 halves both
+  the transport and the full-parameter transient (the update math still
+  runs fp32 inside the fused elementwise chain — only the *stored*
+  buffers narrow).
+* ``exp_avg_dtype`` — first-moment storage.  bf16 halves the momentum
+  buffer (1.3 GB/10⁹ params); the variance stays fp32 (its dynamic
+  range IS the adaptive step size — narrowing it changes the update far
+  more than momentum rounding does).
+
+All default to the r5 behavior (fp32 everywhere): existing callers and
+the parity tests are unchanged.  The fitting sweep behind the choices is
+recorded in BASELINE.md (gpt1p3b section).
 """
 
 from __future__ import annotations
@@ -60,6 +81,10 @@ class DistributedShardedOptimizer:
     axis_name: str = "data"
     grad_average: bool = True
     e5m2_allgather: bool = False  # reference distributed_fused_lamb.py:93
+    # memory-fit knobs (see module docstring); None = fp32 (r5 behavior)
+    scatter_dtype: Optional[Any] = None
+    gather_dtype: Optional[Any] = None
+    exp_avg_dtype: Any = jnp.float32
 
     # -- host-side setup -----------------------------------------------------
 
@@ -74,7 +99,7 @@ class DistributedShardedOptimizer:
         shard = schema.total // n_shards
         return ShardedOptState(
             step=jnp.zeros((), jnp.int32),
-            exp_avg=jnp.zeros((shard,), jnp.float32),
+            exp_avg=jnp.zeros((shard,), self.exp_avg_dtype),
             exp_avg_sq=jnp.zeros((shard,), jnp.float32),
         )
 
@@ -94,14 +119,23 @@ class DistributedShardedOptimizer:
         rank = jax.lax.axis_index(self.axis_name)
         shard = schema.total // world
 
-        flat_g, _ = flatten(grads, schema, dtype=jnp.float32)
+        flat_g, _ = flatten(grads, schema,
+                            dtype=self.scatter_dtype or jnp.float32)
         # reduce-scatter: each rank receives the summed shard it owns
-        g_shard = jax.lax.psum_scatter(flat_g, self.axis_name, tiled=True)
+        # (in scatter_dtype — the reference's fp16 flat grad buffer);
+        # the update math upcasts to fp32 inside the fused chain
+        g_shard = jax.lax.psum_scatter(flat_g, self.axis_name,
+                                       tiled=True).astype(jnp.float32)
         if self.grad_average:
             g_shard = g_shard / world
 
-        flat_p, _ = flatten(params, schema, dtype=jnp.float32)
-        p_shard = jax.lax.dynamic_slice_in_dim(flat_p, rank * shard, shard)
+        # e5m2 delta transport needs the fp32 base regardless of
+        # gather_dtype (the compressed delta is the transport narrowing)
+        flat_dtype = (jnp.float32 if self.e5m2_allgather
+                      else self.gather_dtype or jnp.float32)
+        flat_p, _ = flatten(params, schema, dtype=flat_dtype)
+        p_shard = jax.lax.dynamic_slice_in_dim(
+            flat_p, rank * shard, shard).astype(jnp.float32)
 
         new_p_shard, new_state = self._shard_update(
             p_shard, g_shard, state, flat_g)
@@ -114,8 +148,9 @@ class DistributedShardedOptimizer:
                                           tiled=True).astype(jnp.float32)
             new_flat_p = flat_p + gathered
         else:
-            new_flat_p = jax.lax.all_gather(new_p_shard, self.axis_name,
-                                            axis=0, tiled=True)
+            new_flat_p = jax.lax.all_gather(
+                new_p_shard.astype(flat_dtype), self.axis_name,
+                axis=0, tiled=True)
         return unflatten(new_flat_p, schema), new_state
 
 
@@ -134,7 +169,9 @@ class DistributedFusedAdam(DistributedShardedOptimizer):
             # classic-Adam mode: L2-style decay folded into the gradient
             # before the moment updates (reference non-AdamW branch)
             g = g + self.weight_decay * p
-        m = b1 * state.exp_avg + (1 - b1) * g
+        # moments compute in fp32 and store in exp_avg_dtype: the
+        # rounding happens once per step on the stored value only
+        m = b1 * state.exp_avg.astype(jnp.float32) + (1 - b1) * g
         v = b2 * state.exp_avg_sq + (1 - b2) * g * g
         if self.bias_correction:
             c1 = 1 - b1 ** step.astype(jnp.float32)
@@ -145,7 +182,7 @@ class DistributedFusedAdam(DistributedShardedOptimizer):
         if self.adam_w_mode:
             update = update + self.weight_decay * p
         new_p = p - self.lr * update
-        return new_p, ShardedOptState(step, m, v)
+        return new_p, ShardedOptState(step, m.astype(self.exp_avg_dtype), v)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -173,7 +210,7 @@ class DistributedFusedLAMB(DistributedShardedOptimizer):
         if self.max_grad_norm > 0:
             clip = jnp.maximum(1.0, global_norm / self.max_grad_norm)
             g = g / clip
-        m = b1 * state.exp_avg + (1 - b1) * g
+        m = b1 * state.exp_avg.astype(jnp.float32) + (1 - b1) * g
         v = b2 * state.exp_avg_sq + (1 - b2) * g * g
         if self.bias_correction:
             c1 = 1 - b1 ** step.astype(jnp.float32)
@@ -186,4 +223,4 @@ class DistributedFusedLAMB(DistributedShardedOptimizer):
         u_norm = jnp.linalg.norm(update)
         trust = jnp.where((p_norm > 0) & (u_norm > 0), p_norm / u_norm, 1.0)
         new_p = p - self.lr * trust * update
-        return new_p, ShardedOptState(step, m, v)
+        return new_p, ShardedOptState(step, m.astype(self.exp_avg_dtype), v)

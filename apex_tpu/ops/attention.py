@@ -1194,8 +1194,14 @@ def _make_bwd_kernel_qkv(*, scale, causal, block, s, hn, group,
                         preferred_element_type=jnp.float32))
 
             def blocksum(parts):
-                return [(_tree_sum(p) if p
-                         else jnp.zeros((block, hn), jnp.float32))
+                # cast each block's fp32 tree-sum to the OUTPUT dtype
+                # here rather than at the joint store: the held
+                # per-head grads are the largest resident term of
+                # _qkv_packed_ok's VMEM estimate, and the cast happens
+                # either way (bitwise-identical result, half the bytes
+                # held for bf16)
+                return [(_tree_sum(p).astype(dqkv_ref.dtype) if p
+                         else jnp.zeros((block, hn), dqkv_ref.dtype))
                         for p in parts]
 
             head_grads.append((blocksum(dq_parts), blocksum(dk_parts),
@@ -1213,9 +1219,16 @@ def _make_bwd_kernel_qkv(*, scale, causal, block, s, hn, group,
 _QKV_VMEM_BUDGET = 12 * 1024 * 1024
 
 
-def _qkv_packed_ok(b, s, num_heads, hn, block, causal, dropout_rate):
+def _qkv_packed_ok(b, s, num_heads, hn, block, causal, dropout_rate,
+                   dtype=jnp.bfloat16):
     """Gate for the packed path: TPU backend, aligned shapes, and the
-    backward's resident set (the larger of the two) within VMEM."""
+    backward's resident set (the larger of the two) within VMEM.
+
+    ``dtype`` is the CALLER's qkv dtype — the estimate must use its real
+    itemsize (ADVICE r5: a hardcoded bf16 itemsize gated fp32 inputs
+    against half their footprint, so near-budget fp32 shapes passed the
+    gate and then failed Mosaic VMEM allocation instead of routing to
+    the fallback)."""
     del causal, dropout_rate
     if jax.default_backend() != "tpu":
         return False
@@ -1224,17 +1237,37 @@ def _qkv_packed_ok(b, s, num_heads, hn, block, causal, dropout_rate):
         return False
     if s % block or block % 16 or hn % 64:
         return False
-    item = 2  # bf16 streams (fp32 inputs also fit: x2 the estimate)
+    item = jnp.dtype(dtype).itemsize
     n_b = s // block
     resident = (
         2 * s * 3 * hn * group * item   # qkv block ×2 buffers
         + 2 * 2 * s * hn * group * item  # do + o blocks ×2
         + 2 * group * n_b * 8 * block * 4  # lse slab ×2
         + 2 * s * 3 * hn * group * item  # dqkv out ×2
-        + group * 3 * s * hn * 4        # held per-head block grads
+        + group * 3 * s * hn * item     # held per-head block grads
+        #                                 (cast to out dtype at blocksum)
         + 3 * block * block * 4         # transient score tiles
     )
     return resident <= _QKV_VMEM_BUDGET
+
+
+def _qkv_packed_block(b, s, num_heads, hn, block, causal, dropout_rate,
+                      dtype=jnp.bfloat16):
+    """Largest block size ≤ the requested one for which the packed
+    kernels fit VMEM, or None when no candidate fits.
+
+    The flagship d=128/s=2048 shape exceeds the budget at the library
+    default block of 512 (whole-sequence streams at 3·hn lanes) but fits
+    at 256 — without this shrink the gate silently dropped exactly the
+    shape class the packed path exists for to the generic grid kernels
+    plus their transposes.  Smaller-than-requested candidates stop at
+    128 (the lane width; score tiles below that underfill the MXU)."""
+    cands = [block] + [c for c in (256, 128) if c < block]
+    for cand in cands:
+        if _qkv_packed_ok(b, s, num_heads, hn, cand, causal,
+                          dropout_rate, dtype):
+            return cand
+    return None
 
 
 def _flash_qkv_fwd_pallas(qkv, dropout_seed, num_heads, hn, scale,
@@ -1310,7 +1343,15 @@ def _flash_qkv_fwd_rule(qkv, dropout_seed, num_heads, hn, scale, causal,
 
     ctx, lse = _flash_qkv_fwd_pallas(qkv, dropout_seed, num_heads, hn,
                                      scale, causal, block, dropout_rate)
-    # same names as the generic path so remat_policy="attn_res" works
+    # same names as the generic path so remat_policy="attn_res" works.
+    # NOTE (ADVICE r5): the checkpointed lse is the raw
+    # [b, n_hg, group, n_b, 8, block] slab — the 8-row sublane
+    # broadcast makes the saved residual 8x the logical [b, h, s] lse
+    # (b·h·s·32 bytes: ~4 MB/layer at the 350M bench shape, ~8 MB at
+    # the 1.3B flagship's b=4/s=2048 — ~0.5% of the attn_res save set
+    # either way).  Slicing row 0 outside the kernel would add one copy
+    # per layer per direction; accepted as-is until activation memory,
+    # not HBM state, becomes the flagship's binding constraint.
     ctx = checkpoint_name(ctx, "flash_attn_out")
     lse = checkpoint_name(lse, "flash_attn_lse")
     return ctx, (qkv, dropout_seed, ctx, lse)
@@ -1370,14 +1411,16 @@ def flash_attention_qkv(
             raise ValueError("dropout_rate > 0 requires dropout_seed")
     # the packed kernels tile both axes with ONE block size; an explicit
     # differing block_k routes to the generic path
-    if (block_k in (None, block)
-            and _qkv_packed_ok(b, s, num_heads, hn, min(block, s),
-                               causal, dropout_rate)
-            and not use_interpret()):
-        seed = 0 if dropout_seed is None else dropout_seed
-        return _flash_attention_qkv(qkv, seed, num_heads, hn,
-                                    float(scale), causal, min(block, s),
-                                    float(dropout_rate))
+    if block_k in (None, block) and not use_interpret():
+        packed_block = _qkv_packed_block(b, s, num_heads, hn,
+                                         min(block, s), causal,
+                                         dropout_rate, qkv.dtype)
+        if packed_block is not None:
+            seed = 0 if dropout_seed is None else dropout_seed
+            return _flash_attention_qkv(qkv, seed, num_heads, hn,
+                                        float(scale), causal,
+                                        packed_block,
+                                        float(dropout_rate))
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (  # [b, np, s, hn]
         jnp.split(qkv.reshape(b, s, num_heads, 3 * hn), 3, axis=-1)))
     ctx = flash_attention(q, k, v, causal=causal, scale=scale,

@@ -30,16 +30,23 @@ DEFAULT_GATES = [
      "1.39x / 0.85 of adjacent HBM roof; was 1.07x XLA-in-custom_vjp)"),
     ("fused_softmax", "speedup", 0.95,
      "ops.fused_softmax: FusedScaleMaskSoftmax fused path (parity-class "
-     "at the bench shape: XLA fuses the naive form equally well)"),
+     "at the bench shape: XLA fuses the naive form equally well; the r6 "
+     "8-cell sk x mask sweep in BENCH_TOPOPS.json fused_softmax_sweep "
+     "is the across-the-window evidence behind keeping the XLA "
+     "formulation — there is no Pallas surface here to demote)"),
     ("xentropy", "speedup", 0.95,
-     "ops.xentropy: saved-lse custom_vjp (bandwidth-parity with naive)"),
+     "ops.xentropy: saved-lse custom_vjp (bandwidth-parity with naive; "
+     "r6 N x V sweep recorded alongside, same verdict protocol)"),
     ("fused_linear_xent", "speedup", 0.95,
      "ops.fused_linear_xent: bf16-residual fused head (GPT tp=1 default)"),
     ("flash_attention_s1024", "fwd_speedup_vs_naive", 1.0,
      "ops.attention: Pallas flash forward"),
     ("flash_attention_qkv", "speedup_vs_unpacked", 0.95,
      "ops.attention: packed-QKV path (the GPT model default) vs the "
-     "generic kernels plus their layout work — must not lose"),
+     "generic kernels plus their layout work, both closed by the "
+     "output-projection GEMM (r6 re-gate: the region the feature "
+     "replaces — an elementwise closer let XLA fold the layout ops "
+     "away and left a flap-prone 1.03x margin) — must not lose"),
     ("flash_attention_s4096", "fwd_speedup_vs_naive", 1.0,
      "ops.attention: Pallas flash forward (long context)"),
 ]

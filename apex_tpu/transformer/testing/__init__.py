@@ -10,6 +10,15 @@ from apex_tpu.transformer.testing.train_loop import (  # noqa: F401
     LoopResult,
     run_resilient_training,
 )
+from apex_tpu.transformer.testing.flagship import (  # noqa: F401
+    FIT_PLANS,
+    FlagshipSetup,
+    ZeroFitPlan,
+    build_flagship_train_step,
+    flagship_state_bytes,
+    gpt1p3b_config,
+    gpt_param_count,
+)
 from apex_tpu.transformer.testing.standalone_gpt import (  # noqa: F401
     GPTConfig,
     GPTModel,

@@ -1,0 +1,241 @@
+"""GPT-1.3B-class flagship: configuration + ZeRO-fit train step.
+
+The benched flagship was pinned for five rounds to GPT-350M (h=1024,
+16 heads → d=64), a shape whose head dim half-fills the MXU contraction
+lanes and caps attention at the measured 54.9 TF dot floor (BASELINE.md
+r5).  This module stands up the shape the hardware likes — **h=2048,
+16 heads → d=128, seq 2048** (~1.32 B params with the 51200 vocab) —
+as a first-class configuration, plus the memory-fit machinery a 1.3B
+model needs on a 16 GB chip.
+
+Following ZeRO (Rajbhandari et al., 2020), the train step wires
+:class:`apex_tpu.contrib.optimizers.DistributedFusedAdam` — psum_scatter
+→ sharded update → all_gather — over the mesh "data" axis, so fp32
+moments live once per shard group instead of once per replica.  The
+same step runs unchanged from 1 chip (world=1: the collectives are
+identity and the *dtype plan* does the fitting) to a v5e-16 pod slice
+(world=N: state is N-way sharded as well).
+
+Fit plans — why a 15.75-GiB (16.9e9-byte) chip needs one (1.32 B
+params; bytes in GB, world=1):
+
+=============  ======  =====  =========  ==================  ========
+plan           params  grads  m / v      optimizer-phase     fits?
+                                         peak (see note)
+=============  ======  =====  =========  ==================  ========
+fp32           5.3     5.3    5.3 / 5.3  26.4 GB             no
+bf16_fp32m     2.6     2.6    5.3 / 5.3  18.5 GB             no
+bf16_fit       2.6     2.6    2.6 / 5.3  15.8 GB             yes
+=============  ======  =====  =========  ==================  ========
+
+Peak note: the ZeRO step packs grads and params into flat superblocks,
+so the optimizer-phase live set is m + v + flat grads + 2× flat params
+(old tree and grad tree freed by donation — ``donate=True`` below is
+load-bearing, not an optimization).  :func:`flagship_state_bytes`
+computes both columns; BASELINE.md (gpt1p3b section) carries the full
+table with the measured counterpart from the chip.
+
+``bf16_fit`` keeps the variance (the adaptive step size) fp32 and
+narrows params/grads/momentum to bf16; the update math itself always
+runs fp32 inside the fused elementwise chain (see
+``contrib/optimizers/distributed_fused.py``).  Parity vs the unsharded
+fp32 FusedAdam is asserted on the emulated mesh in
+``tests/L0/test_flagship.py`` (max|dw| ≤ 1e-3 — ISSUE 2 acceptance).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.contrib.optimizers import DistributedFusedAdam
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.testing.standalone_gpt import GPTConfig, GPTModel
+
+__all__ = [
+    "GPT1P3B_KW",
+    "ZeroFitPlan",
+    "FIT_PLANS",
+    "gpt1p3b_config",
+    "gpt_param_count",
+    "flagship_state_bytes",
+    "build_flagship_train_step",
+    "FlagshipSetup",
+]
+
+
+# The flagship shape (ISSUE 2): 16 heads at h=2048 give d=128 — full MXU
+# contraction-lane fill, the regime where the flash kernels measure
+# 0.67 of roof (BENCH_r05 flash_attention_s4096) vs 0.90-of-a-54.9-TF-
+# floor at d=64.  Block 256: the packed-QKV kernels' whole-sequence
+# working set at 3·128 lanes exceeds the VMEM budget at the 512 library
+# default but fits at 256 (ops.attention._qkv_packed_block shrinks
+# automatically; the config pins it so the routing is explicit).
+GPT1P3B_KW = dict(
+    num_layers=24,
+    hidden_size=2048,
+    num_attention_heads=16,
+    vocab_size=51200,
+    max_position_embeddings=2048,
+    bf16=True,
+    use_flash_attention=True,
+    remat=True,
+    remat_policy="attn_res",
+    flash_block_q=256,
+    flash_block_k=256,
+)
+
+
+def gpt1p3b_config(**overrides) -> GPTConfig:
+    """The 1.3B flagship :class:`GPTConfig`; ``overrides`` for toy-depth
+    test/trajectory variants (keep ``hidden_size / num_attention_heads
+    = 128`` when shrinking, so the d=128 kernel routing stays the one
+    under test)."""
+    return GPTConfig(**{**GPT1P3B_KW, **overrides})
+
+
+def gpt_param_count(cfg: GPTConfig) -> int:
+    """Analytic parameter count of the standalone GPT (biases and
+    layernorms included): per layer 12h² GEMM weights + 13h vectors,
+    plus word/position embeddings and the final layernorm."""
+    h, L = cfg.hidden_size, cfg.num_layers
+    per_layer = 12 * h * h + 13 * h
+    return (L * per_layer
+            + (cfg.vocab_size + cfg.max_position_embeddings) * h
+            + 2 * h)
+
+
+@dataclasses.dataclass(frozen=True)
+class ZeroFitPlan:
+    """Storage dtypes for the ZeRO step (see module table)."""
+
+    name: str
+    param_dtype: Any
+    exp_avg_dtype: Any
+    scatter_dtype: Optional[Any]  # flat-grad / reduce-scatter transport
+    gather_dtype: Optional[Any]   # updated-shard all_gather transport
+
+
+FIT_PLANS = {
+    # fp32 everything — the r5 350M construction; does NOT fit 1.3B on
+    # one 16 GB chip (kept for parity tests and small models)
+    "fp32": ZeroFitPlan("fp32", jnp.float32, jnp.float32, None, None),
+    # bf16 params/transport, both moments fp32 — 15.8 GB of state+grads
+    # at 1.3B: still over the single-chip budget, fits at world ≥ 2
+    "bf16_fp32m": ZeroFitPlan("bf16_fp32m", jnp.bfloat16, jnp.float32,
+                              jnp.bfloat16, jnp.bfloat16),
+    # the single-chip 1.3B fit: bf16 momentum as well; variance stays
+    # fp32 (it IS the adaptive step size — see distributed_fused.py)
+    "bf16_fit": ZeroFitPlan("bf16_fit", jnp.bfloat16, jnp.bfloat16,
+                            jnp.bfloat16, jnp.bfloat16),
+}
+
+
+def flagship_state_bytes(cfg: GPTConfig, plan: ZeroFitPlan,
+                         n_shards: int = 1) -> dict:
+    """Analytic persistent-state + grad bytes for the fitting table
+    (BASELINE.md gpt1p3b section); activations/logits excluded."""
+    n = gpt_param_count(cfg)
+    it = lambda d: jnp.dtype(d).itemsize
+    out = {
+        "params": n * it(plan.param_dtype),
+        "grads": n * it(plan.scatter_dtype or jnp.float32),
+        "exp_avg": n * it(plan.exp_avg_dtype) // n_shards,
+        "exp_avg_sq": n * 4 // n_shards,
+    }
+    out["total"] = sum(out.values())
+    # optimizer-phase live set (module docstring "peak note"): with the
+    # param and grad TREES donated/freed, the step holds moments + the
+    # flat grad buffer + old and new flat param buffers at once
+    flat_param = n * it(plan.gather_dtype or jnp.float32)
+    out["step_peak"] = (out["exp_avg"] + out["exp_avg_sq"]
+                        + out["grads"] + 2 * flat_param)
+    return out
+
+
+class FlagshipSetup(NamedTuple):
+    """Everything the bench/tests need from one flagship construction."""
+
+    step: Any          # jitted (params, opt_state, tokens, labels) -> …
+    params: Any        # pytree in plan.param_dtype
+    opt_state: Any     # per-rank ZeRO state, leading [n_shards] axis
+    mesh: Any
+    schema: Any
+    opt: DistributedFusedAdam
+    model: GPTModel
+    plan: ZeroFitPlan
+
+
+def build_flagship_train_step(
+    cfg: GPTConfig,
+    *,
+    plan: str | ZeroFitPlan = "bf16_fit",
+    lr: float = 1e-4,
+    weight_decay: float = 0.0,
+    devices: Optional[Sequence] = None,
+    donate: bool = True,
+    seed: int = 0,
+) -> FlagshipSetup:
+    """One flagship construction: model + ZeRO-sharded FusedAdam over
+    the "data" axis of a fresh ``parallel_state`` mesh spanning
+    ``devices`` (default: all local devices — 1 on a single chip, 8 on
+    the emulated CPU mesh).
+
+    The returned ``step(params, opt_state, tokens, labels)`` expects the
+    GLOBAL batch (sharded over "data" internally; batch must divide the
+    device count) and returns ``(params, opt_state, loss)`` with params
+    bitwise-replicated across ranks.  ``donate=True`` donates params and
+    optimizer state — at 1.3B the old buffers ARE the fit margin.
+    """
+    if isinstance(plan, str):
+        plan = FIT_PLANS[plan]
+    devs = list(devices if devices is not None else jax.devices())
+    parallel_state.destroy_model_parallel()
+    mesh = parallel_state.initialize_model_parallel(1, 1, devices=devs)
+    n_shards = len(devs)
+
+    model = GPTModel(cfg)
+    params = model.shard_master(model.init_master(jax.random.PRNGKey(seed)),
+                                0)
+    params = jax.tree_util.tree_map(
+        lambda a: a.astype(plan.param_dtype), params)
+
+    opt = DistributedFusedAdam(
+        lr=lr, weight_decay=weight_decay,
+        scatter_dtype=plan.scatter_dtype,
+        gather_dtype=plan.gather_dtype,
+        exp_avg_dtype=plan.exp_avg_dtype)
+    schema = opt.make_schema(params, n_shards)
+    state0 = opt.init(params, schema, n_shards)
+    # per-rank state with an explicit leading shard axis (every rank's
+    # init is zeros, so a broadcast is exact)
+    opt_state = jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a[None], (n_shards, *a.shape)), state0)
+
+    def inner(p, state, tokens, labels):
+        state = jax.tree_util.tree_map(lambda a: a[0], state)
+
+        def lossf(p):
+            return jnp.mean(model.apply(p, tokens, labels=labels))
+
+        loss, grads = jax.value_and_grad(lossf)(p)
+        new_p, new_state = opt.step(grads, state, p, schema)
+        loss = jax.lax.pmean(loss, opt.axis_name)
+        return (new_p,
+                jax.tree_util.tree_map(lambda a: a[None], new_state),
+                loss)
+
+    sharded = shard_map(
+        inner, mesh=mesh,
+        in_specs=(P(), P("data"), P("data"), P("data")),
+        out_specs=(P(), P("data"), P()),
+        check_rep=False)
+    step = jax.jit(sharded,
+                   donate_argnums=(0, 1) if donate else ())
+    return FlagshipSetup(step, params, opt_state, mesh, schema, opt,
+                         model, plan)

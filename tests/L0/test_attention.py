@@ -83,6 +83,7 @@ class TestFlashAttention:
         g2 = jax.grad(loss_ref)(qkv)
         np.testing.assert_allclose(g1, g2, rtol=1e-3, atol=1e-4)
 
+    @pytest.mark.slow  # interpret-mode packed-QKV kernels (ISSUE 2 CI satellite)
     def test_packed_qkv_kernels_interpret_mode(self):
         # CI coverage for the packed Pallas kernels themselves (the
         # public wrapper routes to the fallback off-TPU): drive the
@@ -116,6 +117,37 @@ class TestFlashAttention:
 
         dref = jax.grad(loss_ref)(qkv)
         np.testing.assert_allclose(dqkv, dref, rtol=1e-3, atol=1e-4)
+
+    def test_qkv_packed_gate_uses_caller_dtype(self, monkeypatch):
+        # ADVICE r5: the VMEM estimate must price the CALLER's itemsize.
+        # At the 350M shape (s=1024, hn=64, block=512) bf16 fits the
+        # budget but fp32 does not — with the old hardcoded itemsize of
+        # 2, fp32 passed the gate and failed Mosaic allocation on chip
+        # instead of routing to the fallback.
+        from apex_tpu.ops import attention as attn_mod
+
+        monkeypatch.setattr(attn_mod.jax, "default_backend",
+                            lambda: "tpu")
+        args = (8, 1024, 16, 64, 512, True, 0.0)
+        assert attn_mod._qkv_packed_ok(*args, jnp.bfloat16)
+        assert not attn_mod._qkv_packed_ok(*args, jnp.float32)
+
+    def test_qkv_packed_block_autoshrink(self, monkeypatch):
+        # the d=128/seq-2048 flagship shape exceeds the budget at the
+        # default block of 512 but fits at 256: the selector must shrink
+        # rather than silently dropping the flagship to the generic
+        # kernels (ISSUE 2 tentpole d).  The 350M shape keeps its
+        # measured-best 512, and fp32 at the 350M shape shrinks to 256.
+        from apex_tpu.ops import attention as attn_mod
+
+        monkeypatch.setattr(attn_mod.jax, "default_backend",
+                            lambda: "tpu")
+        pick = attn_mod._qkv_packed_block
+        assert pick(4, 2048, 16, 128, 512, True, 0.0, jnp.bfloat16) == 256
+        assert pick(8, 1024, 16, 64, 512, True, 0.0, jnp.bfloat16) == 512
+        assert pick(8, 1024, 16, 64, 512, True, 0.0, jnp.float32) == 256
+        # an unalignable shape yields None (generic path)
+        assert pick(8, 1000, 16, 64, 512, True, 0.0, jnp.bfloat16) is None
 
     def test_causal_sq_longer_than_sk(self):
         # causal cross-attention with sq > sk: the leading q rows attend
@@ -212,6 +244,7 @@ class TestFlashAttention:
         # batch selectors of the two BlockSpec families must not cross
         (False, True, True),
     ])
+    @pytest.mark.slow  # interpret-mode Pallas backward cells (ISSUE 2 CI satellite)
     def test_pallas_bwd_interpret_matches(self, causal, with_mask, with_seg):
         """The Pallas dq/dkv kernels (interpret mode) against jax.grad of
         the naive reference — every mask/seg/causal combination."""
@@ -561,6 +594,7 @@ class TestKernelDropout:
             flash_attention(q, k, v, dropout_rate=0.1)
 
 
+@pytest.mark.slow  # interpret-mode dropout kernels (ISSUE 2 CI satellite)
 def test_pallas_dropout_kernels_interpret_match_dense():
     """The Pallas fwd + dq/dkv kernels WITH in-kernel dropout (interpret
     mode) against the dense masked reference using the same hash mask —

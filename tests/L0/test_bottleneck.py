@@ -18,6 +18,10 @@ from apex_tpu.contrib.bottleneck import (
     spatial_conv2d,
 )
 
+# whole-module slow tier (ISSUE 2 CI satellite): every case here is
+# an 8-device-mesh halo-exchange parity run (~60 s total)
+pytestmark = pytest.mark.slow
+
 SPATIAL = 4
 
 

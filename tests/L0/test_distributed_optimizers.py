@@ -128,6 +128,47 @@ class TestDistributedFusedAdam:
         p, state = train_50(params, state)
         assert dist(p) < d0 * 0.2
 
+    def test_dtype_plan_close_to_fp32(self, mesh):
+        """The r6 memory-fit knobs (bf16 scatter/gather transport + bf16
+        momentum storage — the gpt1p3b bf16_fit plan): update math stays
+        fp32 inside the fused chain, so one step agrees with the
+        all-fp32 optimizer to bf16-rounding tolerance."""
+        params = _params(jax.random.PRNGKey(0))
+        grads = _params(jax.random.PRNGKey(1))
+        dopt = DistributedFusedAdam(
+            lr=1e-2, scatter_dtype=jnp.bfloat16,
+            gather_dtype=jnp.bfloat16, exp_avg_dtype=jnp.bfloat16)
+        schema = dopt.make_schema(params, N_DEV)
+
+        def inner(p, g):
+            state = dopt.init(p, schema, N_DEV)
+            assert state.exp_avg.dtype == jnp.bfloat16
+            new_p, new_s = dopt.step(g, state, p, schema)
+            assert new_s.exp_avg.dtype == jnp.bfloat16
+            assert new_s.exp_avg_sq.dtype == jnp.float32
+            return new_p
+
+        out = shard_map(inner, mesh=mesh, in_specs=(P(), P()),
+                        out_specs=P(), check_rep=False)(params, grads)
+        ref = DistributedFusedAdam(lr=1e-2)
+
+        def ref_inner(p, g):
+            state = ref.init(p, schema, N_DEV)
+            new_p, _ = ref.step(g, state, p, schema)
+            return new_p
+
+        out_r = shard_map(ref_inner, mesh=mesh, in_specs=(P(), P()),
+                          out_specs=P(), check_rep=False)(params, grads)
+        for k in params:
+            # gathering fp32 params through bf16 transport quantizes the
+            # values themselves: the bound is ~2 bf16 ulps relative
+            # (one from the gather, one from the update diff).  In the
+            # real fit plan params are STORED bf16, so this rounding is
+            # the storage format, not an extra loss.
+            np.testing.assert_allclose(out[k], out_r[k], rtol=2e-2,
+                                       atol=1e-3)
+
+    @pytest.mark.slow  # 8-device e5m2 transport parity (ISSUE 2 CI satellite)
     def test_e5m2_allgather_close(self, mesh):
         params = _params(jax.random.PRNGKey(0))
         grads = _params(jax.random.PRNGKey(1))

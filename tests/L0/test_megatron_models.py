@@ -52,6 +52,7 @@ def _serial_gpt_loss(cfg1, master, tokens, labels):
 
 
 class TestGPTTensorParallel:
+    @pytest.mark.slow  # 8-device TP4 parity (ISSUE 2 CI satellite)
     def test_tp4_matches_tp1(self):
         # reference run_layers_test/run_megatron_gpt: same master weights,
         # different tp -> identical loss
@@ -390,6 +391,7 @@ class TestFlashAndRemat:
                                    np.asarray(o_soft),
                                    rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow  # remat grad parity (interpret-mode kernels) (ISSUE 2 CI satellite)
     def test_remat_grads_match(self):
         kw = dict(num_layers=2, hidden_size=32, num_attention_heads=4,
                   vocab_size=VOCAB, max_position_embeddings=SEQ, tp_size=1)
@@ -460,6 +462,7 @@ class TestDropout:
                          tp_size=tp, attention_dropout=0.3,
                          hidden_dropout=0.25)
 
+    @pytest.mark.slow  # 8-device dropout statistics (ISSUE 2 CI satellite)
     def test_dropout_active_and_deterministic(self):
         cfg = self._dropout_cfg(1)
         parallel_state.destroy_model_parallel()
@@ -522,6 +525,7 @@ class TestDropout:
         np.testing.assert_allclose(np.asarray(hs[0]), np.asarray(hs[1]),
                                    rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.slow  # 8-device BERT dropout statistics (ISSUE 2 CI satellite)
     def test_bert_dropout_active_and_deterministic(self):
         cfg = BertConfig(num_layers=2, hidden_size=32, num_attention_heads=4,
                          vocab_size=VOCAB, max_position_embeddings=SEQ,
@@ -551,6 +555,7 @@ class TestDropout:
         parallel_state.destroy_model_parallel()
         assert la == lb and la != lc and np.isfinite(la)
 
+    @pytest.mark.slow  # 8-device in-kernel dropout (ISSUE 2 CI satellite)
     def test_flash_path_dropout_in_kernel(self):
         """use_flash_attention + attention_dropout uses the in-kernel
         dropout (no S×S probs): deterministic per key, active, and the
@@ -617,13 +622,21 @@ class TestMoEGPT:
         tokens = _tokens(jax.random.PRNGKey(1))
         labels = _tokens(jax.random.PRNGKey(2))
 
+        # jax 0.4.37 compat: under check_rep=False, shard_map AD turns
+        # forward residuals into extra outputs with inferred specs, and
+        # the MoE aux-loss SCALAR residual has no rank to carry them —
+        # value_and_grad over the bare shard_map dies with _SpecError.
+        # jax.checkpoint over the shard_map keeps residuals internal
+        # (the backward re-runs the forward inside), same math.
+        inner = shard_map(
+            lambda p, t, l: jnp.mean(model.apply(p, t, labels=l)),
+            mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(),
+            check_rep=False)
+
         @jax.jit
         def step(p, o):
             def lossf(p):
-                return shard_map(
-                    lambda p, t, l: jnp.mean(model.apply(p, t, labels=l)),
-                    mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(),
-                    check_rep=False)(p, tokens, labels)
+                return jax.checkpoint(inner)(p, tokens, labels)
 
             loss, g = jax.value_and_grad(lossf)(p)
             p, o = opt.step(g, o, p)
@@ -641,6 +654,7 @@ class TestMoEGPT:
         parallel_state.destroy_model_parallel()
         assert np.isfinite(float(loss)) and float(loss) < first
 
+    @pytest.mark.slow  # 8-device MoE TP parity (ISSUE 2 CI satellite)
     def test_moe_gpt_tp2_matches_tp1(self):
         """Experts replicated across TP: tp=2 must equal tp=1 exactly
         (gate runs on the TP-replicated hidden, routing agrees)."""

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from apex_tpu import amp, optimizers
 from apex_tpu.models import ResNet, ResNetConfig
@@ -82,6 +83,7 @@ class TestGraftEntry:
         out = jax.jit(fn)(*args)
         assert out.shape[-1] == 1024
 
+    @pytest.mark.slow  # 8-device multichip dryrun (ISSUE 2 CI satellite)
     def test_dryrun_multichip(self):
         import __graft_entry__ as ge
         ge.dryrun_multichip(8)

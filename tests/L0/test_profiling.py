@@ -86,6 +86,7 @@ class TestCostReport:
 
 
 class TestTrace:
+    @pytest.mark.slow  # profiler capture round-trip (ISSUE 2 CI satellite)
     def test_trace_writes_profile(self, tmp_path):
         logdir = str(tmp_path / "tb")
         with profiling.trace(logdir):

@@ -7,7 +7,12 @@ import pytest
 from tests.L1.common.harness import RunConfig, compare_trajectories, run_trajectory
 
 
-@pytest.mark.parametrize("opt_level,rtol", [("O0", 2e-3), ("O2", 3e-2)])
+@pytest.mark.parametrize("opt_level,rtol", [
+    ("O0", 2e-3),
+    # the O2 cell repeats the same 8-device parity at the slower mixed-
+    # precision build — held for the slow tier (ISSUE 2 CI satellite)
+    pytest.param("O2", 3e-2, marks=pytest.mark.slow),
+])
 def test_dp8_matches_single_device(opt_level, rtol):
     """Same global batch split 8 ways (SyncBN pools the stats, grads pmean):
     trajectory must match the 1-device run to fp reassociation tolerance
@@ -21,6 +26,7 @@ def test_dp8_matches_single_device(opt_level, rtol):
     compare_trajectories(single, dp, bitwise=False, rtol=rtol)
 
 
+@pytest.mark.slow  # 8-device DP bitwise determinism (~35 s) (ISSUE 2 CI satellite)
 def test_dp8_deterministic_bitwise():
     cfg = RunConfig(model="resnet", opt_level="O2", steps=8, n_devices=8)
     compare_trajectories(run_trajectory(cfg), run_trajectory(cfg), bitwise=True)

@@ -30,6 +30,7 @@ def _args(tmp, extra=()):
     ]
 
 
+@pytest.mark.slow  # end-to-end GPT CLI train+resume (~40 s) (ISSUE 2 CI satellite)
 def test_train_checkpoint_resume(pretrain, tmp_path):
     tmp = str(tmp_path / "ckpt")
     loss = pretrain.main(_args(tmp))

@@ -52,6 +52,7 @@ def test_evaluate_only(capsys):
     assert "Prec@1" in out and "Epoch" not in out
 
 
+@pytest.mark.slow  # 8-device SyncBN example run (~17 s) (ISSUE 2 CI satellite)
 def test_data_parallel_sync_bn(capsys):
     mod = _load_main()
     state = mod.main(TINY + ["--epochs", "1", "--n-devices", "8", "--sync_bn",
