@@ -15,6 +15,7 @@ from apex_tpu.checkpoint.checkpoint import (
     latest_step,
     restore_checkpoint,
     save_checkpoint,
+    shard_file,
     step_dir,
     verify_checkpoint,
 )
@@ -26,6 +27,7 @@ __all__ = [
     "restore_checkpoint",
     "verify_checkpoint",
     "latest_step",
+    "shard_file",
     "step_dir",
     "CheckpointCorruptionError",
     "RetryPolicy",

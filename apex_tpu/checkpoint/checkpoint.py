@@ -54,6 +54,11 @@ _LATEST = "latest"
 _PACK_ALIGN = 64
 
 
+def shard_file(rank: int) -> str:
+    """On-disk name of one shard's array file in a sharded checkpoint."""
+    return f"shard_{int(rank):05d}.npz"
+
+
 class CheckpointCorruptionError(RuntimeError):
     """A checkpoint on disk failed integrity verification (missing files,
     unreadable archive, truncated arrays, or CRC32 digest mismatch)."""
@@ -79,7 +84,8 @@ class RetryPolicy:
 # Test-only fault-injection point (see apex_tpu.resilience.chaos). When set,
 # called as hook(event, path) at each storage operation; it may raise to
 # simulate a write failure or sleep to simulate slow storage.  Events:
-# "write_arrays", "write_manifest", "commit", "read_arrays".
+# "write_arrays", "write_shard" (once per rank file of a sharded save),
+# "write_manifest", "commit", "read_arrays".
 _fault_hook: Optional[Callable[[str, str], None]] = None
 
 
@@ -226,6 +232,7 @@ def save_checkpoint(
     packed: bool = False,
     blocking: bool = True,
     retry: Optional[RetryPolicy] = None,
+    shard_axis: Optional[str] = None,
 ) -> str:
     """Write ``tree`` as checkpoint ``step`` under ``ckpt_dir``.
 
@@ -251,6 +258,19 @@ def save_checkpoint(
     Every array's CRC32 digest is recorded in ``manifest.json`` for
     restore-side integrity verification (:func:`verify_checkpoint`).
 
+    ``shard_axis`` — name of the mesh axis ZeRO state is sharded over
+    (e.g. ``"data"``).  Leaves whose ``shardings`` spec LEADS with that
+    axis are treated as a stack of per-rank partitions along axis 0:
+    each rank's slice goes to its own ``shard_<r>.npz`` file with its
+    own CRC32 digest (``crc32_shards`` in the manifest), and the
+    manifest gains a top-level ``topology`` record (axis name, shard
+    count, mesh shape when recoverable).  Restore understands the
+    format transparently — including onto a mesh of a *different* shard
+    count (see :func:`restore_checkpoint`'s reshard notes).  Replicated
+    leaves (spec not led by ``shard_axis``) are stored once, exactly as
+    in the unsharded format.  Sharded saves require ``shardings`` and
+    are npz-only (``packed=True`` is rejected).
+
     Returns the checkpoint directory path.
     """
     # Only process 0 writes; the guard precedes any device_get so non-writing
@@ -265,6 +285,13 @@ def save_checkpoint(
     from apex_tpu.resilience import async_checkpoint as _async
 
     _async.wait_for_save()
+
+    if shard_axis is not None and shardings is None:
+        raise ValueError(
+            "shard_axis requires shardings: the PartitionSpec tree is what "
+            "identifies which leaves are per-rank partitions")
+    if shard_axis is not None and packed:
+        raise ValueError("sharded checkpoints are npz-only (packed=False)")
 
     leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
     spec_map = _spec_map(shardings, tree) if shardings is not None else {}
@@ -286,6 +313,9 @@ def save_checkpoint(
 
     manifest = {"step": int(step), "format": 1, "leaves": {}}
     arrays = {}
+    n_shards: Optional[int] = None
+    mesh_shape: Optional[dict] = None
+    shard_arrays: list = []
     for path, leaf in leaves:
         # (None leaves never appear here: tree_flatten treats None as an
         # empty subtree, so None-valued fields are simply absent and
@@ -299,6 +329,11 @@ def save_checkpoint(
             while f"{key}#{i}" in manifest["leaves"]:
                 i += 1
             key = f"{key}#{i}"
+        if mesh_shape is None:
+            try:  # best-effort topology evidence for the manifest
+                mesh_shape = dict(leaf.sharding.mesh.shape)
+            except Exception:
+                pass
         val = np.asarray(jax.device_get(leaf))
         entry = {"kind": "array", "dtype": str(val.dtype),
                  "shape": list(val.shape), "path": _path_parts(path)}
@@ -311,29 +346,82 @@ def save_checkpoint(
                 val = val.view(np.uint16)
                 entry["stored_dtype"] = "uint16_bits"
         ptuple = tuple(entry["path"])
-        if ptuple in spec_map:
-            entry["spec"] = _spec_to_json(spec_map[ptuple])
+        spec = spec_map.get(ptuple)
+        if spec is not None:
+            entry["spec"] = _spec_to_json(spec)
+        if shard_axis is not None and _spec_leads_with(spec, shard_axis):
+            if val.ndim == 0:
+                raise ValueError(
+                    f"leaf {key} has spec leading with {shard_axis!r} but "
+                    "no leading axis to partition")
+            if n_shards is None:
+                n_shards = int(val.shape[0])
+                shard_arrays = [dict() for _ in range(n_shards)]
+            elif val.shape[0] != n_shards:
+                raise ValueError(
+                    f"inconsistent shard counts in one save: leaf {key} "
+                    f"has leading axis {val.shape[0]}, earlier sharded "
+                    f"leaves have {n_shards}")
+            entry["shard_axis"] = shard_axis
+            # a per-rank REPLICATED stack must re-broadcast on reshard,
+            # not concat.  Only 1-D [n_shards] stacks (per-rank scalars
+            # like the broadcast opt step counter) qualify: a >=2-D
+            # stack is by contract a flat-buffer partition, even when
+            # its content happens to be rank-identical (a fresh ZeRO
+            # init's all-zero moments must reshard by concat, and the
+            # cheap per-scalar compare keeps the foreground snapshot
+            # phase free of O(bytes) work)
+            entry["replicated_shards"] = bool(
+                val.ndim == 1
+                and all(np.array_equal(val[r], val[0])
+                        for r in range(1, n_shards)))
+            for r in range(n_shards):
+                shard_arrays[r][key] = val[r]
+        else:
+            manifest["leaves"][key] = entry
+            arrays[key] = val
+            continue
         manifest["leaves"][key] = entry
-        arrays[key] = val
+    if n_shards is not None:
+        manifest["format"] = 3
+        manifest["topology"] = {"shard_axis": shard_axis,
+                                "n_shards": n_shards}
+        if mesh_shape is not None:
+            manifest["topology"]["mesh_shape"] = mesh_shape
 
     # everything below is pure host/disk work on the snapshot — safe to run
     # on the background writer thread
     if blocking:
         _write_checkpoint_files(ckpt_dir, step, manifest, arrays,
-                                packed=packed, keep=keep, retry=retry)
+                                packed=packed, keep=keep, retry=retry,
+                                shard_arrays=shard_arrays)
     else:
         _async.submit_save(
             lambda: _write_checkpoint_files(ckpt_dir, step, manifest, arrays,
                                             packed=packed, keep=keep,
-                                            retry=retry),
+                                            retry=retry,
+                                            shard_arrays=shard_arrays),
             label=f"{ckpt_dir}:step_{int(step)}")
     return step_dir(ckpt_dir, step)
+
+
+def _spec_leads_with(spec, axis: str) -> bool:
+    """True when PartitionSpec ``spec``'s FIRST dimension entry names
+    ``axis`` (directly or inside a tuple) — the test for "this leaf is a
+    stack of per-rank partitions along axis 0"."""
+    if spec is None or len(spec) == 0:
+        return False
+    head = spec[0]
+    if isinstance(head, (tuple, list)):
+        return axis in head
+    return head == axis
 
 
 def _write_checkpoint_files(ckpt_dir: str, step: int, manifest: dict,
                             arrays: dict, *, packed: bool,
                             keep: Optional[int],
-                            retry: Optional[RetryPolicy]) -> str:
+                            retry: Optional[RetryPolicy],
+                            shard_arrays: Optional[list] = None) -> str:
     """Disk phase of a save: tmp dir -> arrays + manifest -> atomic rename ->
     latest marker -> keep-GC.  Retries the whole tmp-dir write on transient
     storage errors (each attempt starts from a fresh tmp dir)."""
@@ -342,14 +430,19 @@ def _write_checkpoint_files(ckpt_dir: str, step: int, manifest: dict,
     # saves — so ``blocking=False`` returns after the device snapshot alone,
     # without a per-leaf hash + tobytes copy stalling the train loop.
     for k, entry in manifest["leaves"].items():
-        entry["crc32"] = zlib.crc32(arrays[k].tobytes()) & 0xFFFFFFFF
+        if k in arrays:
+            entry["crc32"] = zlib.crc32(arrays[k].tobytes()) & 0xFFFFFFFF
+        else:  # sharded leaf: one digest per rank's partition
+            entry["crc32_shards"] = [
+                zlib.crc32(sh[k].tobytes()) & 0xFFFFFFFF
+                for sh in shard_arrays]
     retry = retry or RetryPolicy(max_attempts=1)
     final = step_dir(ckpt_dir, step)
     last_err = None
     for attempt in range(retry.max_attempts):
         try:
             _write_step_dir_once(ckpt_dir, step, manifest, arrays,
-                                 packed=packed)
+                                 packed=packed, shard_arrays=shard_arrays)
             break
         except retry.retryable as e:
             last_err = e
@@ -377,7 +470,8 @@ def _write_checkpoint_files(ckpt_dir: str, step: int, manifest: dict,
 
 
 def _write_step_dir_once(ckpt_dir: str, step: int, manifest: dict,
-                         arrays: dict, *, packed: bool) -> None:
+                         arrays: dict, *, packed: bool,
+                         shard_arrays: Optional[list] = None) -> None:
     """One attempt at writing + committing ``step_<N>/``."""
     os.makedirs(ckpt_dir, exist_ok=True)
     final = step_dir(ckpt_dir, step)
@@ -385,6 +479,14 @@ def _write_step_dir_once(ckpt_dir: str, step: int, manifest: dict,
     if os.path.exists(tmp):
         shutil.rmtree(tmp)
     os.makedirs(tmp)
+    if shard_arrays:
+        # per-rank partition files; each gets its own fault event so the
+        # chaos tier can kill a save mid-shard-set (the commit is still
+        # atomic: nothing is visible until the rename below)
+        for r, sh in enumerate(shard_arrays):
+            p = os.path.join(tmp, shard_file(r))
+            _fault("write_shard", p)
+            np.savez(p, **sh)
     if packed:
         from apex_tpu import _native
 
@@ -440,6 +542,7 @@ def _load_manifest_and_data(d: str, *, verify: bool):
                 f"unreadable manifest in {d}: {e}") from e
         raise
     pack_path = os.path.join(d, _PACK)
+    shard_data: list = []
     try:
         if os.path.exists(pack_path):  # format 2: flat superblock
             buf = np.fromfile(pack_path, np.uint8)
@@ -449,25 +552,52 @@ def _load_manifest_and_data(d: str, *, verify: bool):
                 data[k] = np.frombuffer(buf, _stored_dtype(e), cnt,
                                         e["offset"]).reshape(e["shape"])
         else:
-            with np.load(os.path.join(d, _ARRAYS)) as npz:
-                data = {k: npz[k] for k in npz.files}
+            data = {}
+            if os.path.exists(os.path.join(d, _ARRAYS)):
+                with np.load(os.path.join(d, _ARRAYS)) as npz:
+                    data = {k: npz[k] for k in npz.files}
+            for r in range(manifest.get("topology", {}).get("n_shards", 0)):
+                with np.load(os.path.join(d, shard_file(r))) as npz:
+                    shard_data.append({k: npz[k] for k in npz.files})
     except Exception as e:
         # truncated pack (frombuffer ValueError), truncated/garbled npz
-        # (zipfile.BadZipFile, EOFError, OSError, KeyError) — with
-        # verify, all of these are one condition: a corrupt checkpoint
+        # (zipfile.BadZipFile, EOFError, OSError, KeyError), missing
+        # shard file — with verify, all of these are one condition: a
+        # corrupt checkpoint
         if verify:
             raise CheckpointCorruptionError(
                 f"unreadable arrays in {d}: {type(e).__name__}: {e}") from e
         raise
+    problems = []
+    for k, e in manifest["leaves"].items():
+        if "shard_axis" not in e:
+            continue
+        # reassemble the logical [n_shards, ...] stack; per-shard CRC
+        # runs while each partition's bytes are in hand
+        parts = []
+        for r, sh in enumerate(shard_data):
+            if k not in sh:
+                problems.append(f"missing {k!r} in shard {r}")
+                continue
+            if verify and "crc32_shards" in e:
+                got = zlib.crc32(np.asarray(sh[k]).tobytes()) & 0xFFFFFFFF
+                want = e["crc32_shards"][r]
+                if got != want:
+                    problems.append(
+                        f"CRC32 mismatch for {k!r} shard {r}: stored "
+                        f"digest {want}, bytes on disk hash to {got}")
+            parts.append(sh[k])
+        if len(parts) == len(shard_data):
+            data[k] = np.stack(parts)
     if verify:
-        problems = []
         for k, e in manifest["leaves"].items():
             if k not in data:
-                problems.append(f"missing stored array {k!r}")
+                if "shard_axis" not in e:  # sharded misses named above
+                    problems.append(f"missing stored array {k!r}")
                 continue
             want = e.get("crc32")
             if want is None:
-                continue  # pre-digest manifest: nothing to check against
+                continue  # pre-digest/sharded manifest: checked above
             got = zlib.crc32(np.asarray(data[k]).tobytes()) & 0xFFFFFFFF
             if got != want:
                 problems.append(
@@ -477,6 +607,9 @@ def _load_manifest_and_data(d: str, *, verify: bool):
             raise CheckpointCorruptionError(
                 f"checkpoint at {d} failed integrity verification: "
                 + "; ".join(problems))
+    elif problems:
+        raise KeyError(
+            f"sharded checkpoint at {d} is incomplete: " + "; ".join(problems))
     return manifest, data
 
 
@@ -521,6 +654,20 @@ def restore_checkpoint(
       :func:`apex_tpu.resilience.restore_resilient` for automatic fallback
       to the newest intact older checkpoint).
 
+    **Cross-topology reshard**: leaves saved with ``shard_axis`` (see
+    :func:`save_checkpoint`) are stacks of per-rank flat-buffer
+    partitions.  When the target leaf's leading axis differs from the
+    saved shard count (an N-device save restoring onto an M-device mesh,
+    including the M=1 debug restore), the stack is re-partitioned by
+    flat-buffer semantics: concatenate the N saved partitions, re-split
+    into M.  Size differences can come only from the flat schema's
+    topology-dependent tail padding (``total_multiple_of = 128·N``), so
+    growth zero-fills and shrinkage requires the dropped tail to be all
+    zeros (anything else raises — that would silently lose optimizer
+    state).  1-D stacks of per-rank scalars recorded as
+    ``replicated_shards`` (the broadcast step counter) re-broadcast
+    rank 0 instead of concatenating.
+
     Returns ``(tree, step)``.
     """
     if step is None:
@@ -546,8 +693,12 @@ def restore_checkpoint(
     else:
         spec_map = {}
 
-    def _materialize(key: str, entry: dict, want_dtype=None):
+    def _materialize(key: str, entry: dict, want_dtype=None,
+                     want_shape=None):
         val = data[key]
+        if (want_shape is not None and "shard_axis" in entry
+                and tuple(val.shape) != tuple(want_shape)):
+            val = _reshard_stack(val, entry, tuple(want_shape), key)
         if entry.get("stored_dtype") == "uint16_bits":
             val = val.view(jnp.dtype(entry["dtype"]))
         dtype = want_dtype if want_dtype is not None else jnp.dtype(entry["dtype"])
@@ -605,11 +756,42 @@ def restore_checkpoint(
     leaves = []
     for path, tleaf in paths:
         key = by_path.get(tuple(_path_parts(path)), _keystr(path))
-        want = None
+        want = shape = None
         if tleaf is not None and hasattr(tleaf, "dtype"):
             want = tleaf.dtype
-        leaves.append(_materialize(key, manifest["leaves"][key], want_dtype=want))
+        if tleaf is not None and hasattr(tleaf, "shape"):
+            shape = tleaf.shape
+        leaves.append(_materialize(key, manifest["leaves"][key],
+                                   want_dtype=want, want_shape=shape))
     return jax.tree_util.tree_unflatten(treedef, leaves), step
+
+
+def _reshard_stack(val: np.ndarray, entry: dict, want_shape: tuple,
+                   key: str) -> np.ndarray:
+    """Re-partition a sharded leaf's stored ``[N, ...]`` stack to the
+    target's ``[M, ...]`` layout (restore_checkpoint's "cross-topology
+    reshard" contract; operates on the STORED dtype, before any
+    precision-portability cast)."""
+    if entry.get("replicated_shards"):
+        # per-rank replicated value (broadcast step counter): rank 0
+        # speaks for all ranks on the new topology
+        if val.shape[1:] != tuple(want_shape[1:]):
+            raise ValueError(
+                f"cannot reshard replicated leaf {key!r}: per-rank shape "
+                f"{val.shape[1:]} != target per-rank shape "
+                f"{tuple(want_shape[1:])}")
+        # contiguous copy: the caller may still .view() the raw-bits
+        # stored dtype, which a broadcast view cannot support
+        return np.ascontiguousarray(np.broadcast_to(val[0], want_shape))
+    # flat-buffer stack: C-order flatten IS the concat of the N
+    # partitions in rank order; the pad/trim contract lives in ONE
+    # place (flat.repartition_flat), shared with the in-memory
+    # reshard_zero_state so on-disk and live semantics cannot diverge
+    from apex_tpu.multi_tensor.flat import repartition_flat
+
+    out = repartition_flat(val, int(np.prod(want_shape)),
+                           label=f"sharded leaf {key!r}")
+    return out.reshape(want_shape)
 
 
 def _filter_spec_entry(part, mesh: Mesh):

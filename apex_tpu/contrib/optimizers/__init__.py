@@ -5,4 +5,6 @@ from apex_tpu.contrib.optimizers.distributed_fused import (  # noqa: F401
     DistributedFusedAdam,
     DistributedFusedLAMB,
     DistributedShardedOptimizer,
+    ShardedOptState,
+    reshard_zero_state,
 )

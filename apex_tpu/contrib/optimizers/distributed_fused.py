@@ -59,6 +59,7 @@ from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from apex_tpu.multi_tensor.flat import FlatSchema, flatten, make_schema, unflatten
 
@@ -67,6 +68,42 @@ class ShardedOptState(NamedTuple):
     step: jnp.ndarray  # i32 scalar
     exp_avg: jnp.ndarray  # [shard] f32 (momentum)
     exp_avg_sq: jnp.ndarray  # [shard] f32 (2nd moment)
+
+
+def reshard_zero_state(opt_state: ShardedOptState, *, n_shards: int,
+                       schema: FlatSchema) -> ShardedOptState:
+    """Re-partition a STACKED per-rank :class:`ShardedOptState` (leading
+    ``[old_n]`` axis on every leaf, the layout the flagship train step
+    carries) onto a new shard count — the in-memory half of the elastic
+    cross-topology story (the on-disk half lives in
+    ``checkpoint.restore_checkpoint``'s sharded-manifest reshard).
+
+    The flat-buffer leaves (``exp_avg``/``exp_avg_sq``) concatenate in
+    rank order to the logical superblock, then re-split ``n_shards``
+    ways against the TARGET ``schema`` (whose ``total`` is padded to
+    ``128·n_shards`` — per-leaf offsets are topology-invariant, only the
+    tail padding moves, so growth zero-fills and shrinkage may drop
+    only all-zero tail padding; dropping real state raises).  The
+    broadcast ``step`` counter re-broadcasts rank 0.  Host-side numpy —
+    this runs once per mesh rebuild, not per step."""
+    from apex_tpu.multi_tensor.flat import repartition_flat
+
+    old_n = int(np.asarray(opt_state.step).shape[0])
+    shard = schema.total // n_shards
+
+    def _flat(leaf) -> jnp.ndarray:
+        a = np.asarray(jax.device_get(leaf))
+        out = repartition_flat(a, n_shards * shard,
+                               label=f"opt shard stack ({old_n}->"
+                                     f"{n_shards})")
+        return jnp.asarray(out.reshape(n_shards, shard))
+
+    step0 = np.asarray(jax.device_get(opt_state.step))[0]
+    return ShardedOptState(
+        step=jnp.broadcast_to(jnp.asarray(step0), (n_shards,)),
+        exp_avg=_flat(opt_state.exp_avg),
+        exp_avg_sq=_flat(opt_state.exp_avg_sq),
+    )
 
 
 @dataclasses.dataclass(frozen=True)

@@ -55,6 +55,35 @@ def _round_up(n: int, align: int) -> int:
     return (n + align - 1) // align * align
 
 
+def repartition_flat(flat: np.ndarray, new_size: int, *,
+                     label: str = "flat buffer") -> np.ndarray:
+    """Resize a 1-D flat superblock for a new shard topology.
+
+    Per-leaf offsets inside a :class:`FlatSchema` are topology-invariant
+    (only the ``total_multiple_of`` tail padding depends on the shard
+    count), so an N→M re-partition is concat → resize → re-split, and
+    the only legal size change is in the padding tail: growth
+    zero-fills; shrinkage requires the dropped tail to be all zeros —
+    anything else is real state and raises.  Shared by the sharded
+    checkpoint reshard (``checkpoint._reshard_stack``) and the
+    in-memory :func:`~apex_tpu.contrib.optimizers.reshard_zero_state`
+    so on-disk and in-memory semantics cannot diverge."""
+    flat = np.ascontiguousarray(flat).reshape(-1)
+    if new_size > flat.size:
+        out = np.zeros((new_size,), flat.dtype)
+        out[: flat.size] = flat
+        return out
+    if new_size < flat.size:
+        if np.any(flat[new_size:] != 0):
+            raise ValueError(
+                f"cannot repartition {label} from {flat.size} to "
+                f"{new_size} elements: the {flat.size - new_size} dropped "
+                "trailing elements are not all zero — that region holds "
+                "real state, not flat-schema padding (schema mismatch?)")
+        return flat[:new_size]
+    return flat
+
+
 def make_schema(tree, *, align: int = 128, total_multiple_of: int = 1) -> FlatSchema:
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     shapes, dtypes, offsets, sizes = [], [], [], []

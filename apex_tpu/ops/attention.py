@@ -627,7 +627,18 @@ def _make_bwd_kernel_tiles(*, scale, causal, block_q, block_k, sq, sk,
     per-tile dot groups (which have no cross-tile dependencies) pipeline
     on the MXU while another tile's VPU softmax/ds math runs.  Gated by
     :func:`_bwd_tiles_ok` (whole-sequence streams + live partials must
-    fit VMEM); larger shapes use the grid-scheduled one-pass kernel."""
+    fit VMEM).
+
+    Alignment rule (ADVICE r5): lse arrives as a dense ``[1, sq]`` LANE
+    row and each q-block reads it via the static slice
+    ``lse_ref[0, 0, qi:qi+block_q]`` — a *lane*-dimension offset, legal
+    in Mosaic only when every ``qi = qb·block_q`` is a multiple of the
+    128-lane width.  The gate therefore requires ``block_q % 128 == 0``
+    or ``sq == block_q`` (single q-block: the only offset is 0);
+    sub-128 caller blocks with multiple q-blocks take the
+    grid-scheduled fallback, whose ``[sq, 1]`` sublane arrangement has
+    no such constraint.  Larger shapes use the same fallback for VMEM
+    reasons."""
     n_qb, n_kb = sq // block_q, sk // block_k
 
     def visible(qi, ki):
@@ -730,13 +741,21 @@ def _make_bwd_kernel_tiles(*, scale, causal, block_q, block_k, sq, sk,
 def _bwd_tiles_ok(q, k, mask_bias, block_q, block_k):
     """VMEM estimate for the unrolled-tiles backward: whole-sequence
     q/k/v/do/lse/delta and dq/dk/dv plus the live dq partials of every
-    q-block and one k-block's dk/dv partials."""
+    q-block and one k-block's dk/dv partials.  Also enforces the
+    kernel's lane-alignment rule (see :func:`_make_bwd_kernel_tiles`):
+    the per-q-block lse lane slice needs ``block_q % 128 == 0`` unless
+    there is only one q-block."""
     if not _pallas_ok(q, k, mask_bias, block_q, block_k):
         return False
     sq, d = q.shape[1], q.shape[2]
     sk = k.shape[1]
     item = q.dtype.itemsize
     bq, bk = min(block_q, sq), min(block_k, sk)
+    if bq % 128 != 0 and sq != bq:
+        # lane-unaligned lse slice offsets (qi = qb·bq not a multiple of
+        # the 128-lane width with >1 q-block): Mosaic lowering is
+        # unverified for this case — route to the grid fallback
+        return False
     n_qb, n_kb = sq // bq, sk // bk
     resident = (
         2 * 3 * sq * d * item      # q, do, o streams ×2 buffers
@@ -1308,6 +1327,10 @@ def _flash_qkv_bwd_pallas(qkv, dropout_seed, ctx, lse, dctx, num_heads,
     n_hg = num_heads // group
     n_b = s // block
     w = group * 3 * hn
+    # the saved residual carries only sublane row 0 of the forward's
+    # 8-row lse slab (the fwd rule slices before checkpoint_name); the
+    # kernel reads row 0 either way, so size the stream to what arrives
+    lse_rows = lse.shape[4]
     seed_specs, seed_args = _seed_spec_arg(dropout_rate, dropout_seed)
     dqkv = pl.pallas_call(
         _make_bwd_kernel_qkv(scale=scale, causal=causal, block=block,
@@ -1319,7 +1342,7 @@ def _flash_qkv_bwd_pallas(qkv, dropout_seed, ctx, lse, dctx, num_heads,
             pl.BlockSpec((1, s, w), lambda bi, g: (bi, 0, g)),
             pl.BlockSpec((1, s, group * hn), lambda bi, g: (bi, 0, g)),
             pl.BlockSpec((1, s, group * hn), lambda bi, g: (bi, 0, g)),
-            pl.BlockSpec((1, 1, group, n_b, 8, block),
+            pl.BlockSpec((1, 1, group, n_b, lse_rows, block),
                          lambda bi, g: (bi, g, 0, 0, 0, 0)),
         ] + seed_specs,
         out_specs=pl.BlockSpec((1, s, w), lambda bi, g: (bi, 0, g)),
@@ -1344,14 +1367,14 @@ def _flash_qkv_fwd_rule(qkv, dropout_seed, num_heads, hn, scale, causal,
     ctx, lse = _flash_qkv_fwd_pallas(qkv, dropout_seed, num_heads, hn,
                                      scale, causal, block, dropout_rate)
     # same names as the generic path so remat_policy="attn_res" works.
-    # NOTE (ADVICE r5): the checkpointed lse is the raw
-    # [b, n_hg, group, n_b, 8, block] slab — the 8-row sublane
-    # broadcast makes the saved residual 8x the logical [b, h, s] lse
-    # (b·h·s·32 bytes: ~4 MB/layer at the 350M bench shape, ~8 MB at
-    # the 1.3B flagship's b=4/s=2048 — ~0.5% of the attn_res save set
-    # either way).  Slicing row 0 outside the kernel would add one copy
-    # per layer per direction; accepted as-is until activation memory,
-    # not HBM state, becomes the flagship's binding constraint.
+    # The kernel emits lse as a [b, n_hg, group, n_b, 8, block] slab
+    # whose 8 sublane rows are identical broadcasts (the (8,128)-tiled
+    # store layout); checkpointing the raw slab saved an 8x residual
+    # (~8 MB/layer at the 1.3B flagship's b=4/s=2048 — ADVICE r5).
+    # Slice row 0 BEFORE checkpoint_name: one small copy per layer, and
+    # the attn_res policy saves the logical-size lse only.  The backward
+    # kernel reads row 0 regardless, so it consumes either slab height.
+    lse = lse[..., :1, :]
     ctx = checkpoint_name(ctx, "flash_attn_out")
     lse = checkpoint_name(lse, "flash_attn_lse")
     return ctx, (qkv, dropout_seed, ctx, lse)

@@ -22,10 +22,17 @@ stack ships:
   (:mod:`~apex_tpu.resilience.guards`);
 - **fault injection** — :mod:`~apex_tpu.resilience.chaos` reproduces all
   of the above deterministically on CPU for the test tier (transient write
-  errors, corrupted/truncated array files, simulated preemption).
+  errors, corrupted/truncated array files, simulated preemption, and —
+  mesh-aware — device loss, shard corruption, slow collectives);
+- **elastic mesh** — :mod:`~apex_tpu.resilience.elastic`: sharded ZeRO
+  checkpoints (per-rank partition files + per-shard CRC32 + topology
+  record), cross-topology N→M restore, the :class:`Watchdog` collective
+  deadline monitor, and :func:`run_elastic_training` device-loss
+  recovery (rebuild on the surviving submesh, resume from the newest
+  intact shard set).
 
 See ``docs/resilience.md`` for the full semantics (fencing rules,
-retention, multi-host notes).
+retention, sharded manifest format, reshard protocol, multi-host notes).
 """
 
 from apex_tpu.checkpoint.checkpoint import (  # noqa: F401
@@ -37,6 +44,15 @@ from apex_tpu.resilience.async_checkpoint import (  # noqa: F401
     AsyncSaveError,
     in_flight,
     wait_for_save,
+)
+from apex_tpu.resilience.elastic import (  # noqa: F401
+    ElasticResult,
+    Watchdog,
+    WatchdogTimeout,
+    largest_divisor_submesh,
+    restore_zero_checkpoint,
+    run_elastic_training,
+    save_zero_checkpoint,
 )
 from apex_tpu.resilience.guards import (  # noqa: F401
     DivergenceError,
@@ -54,12 +70,19 @@ __all__ = [
     "CheckpointCorruptionError",
     "CheckpointFallbackWarning",
     "DivergenceError",
+    "ElasticResult",
     "GracePeriodHandler",
     "RetryPolicy",
     "StepGuard",
+    "Watchdog",
+    "WatchdogTimeout",
     "first_nonfinite_leaf",
     "in_flight",
+    "largest_divisor_submesh",
     "restore_resilient",
+    "restore_zero_checkpoint",
+    "run_elastic_training",
+    "save_zero_checkpoint",
     "verify_checkpoint",
     "wait_for_save",
 ]

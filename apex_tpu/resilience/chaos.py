@@ -16,6 +16,22 @@ mid-run.  This module makes those conditions *reproducible on CPU*:
   (or calls ``handler.request_stop()`` off the main thread) after a chosen
   number of step-boundary polls.
 
+Mesh-aware faults (the elastic tier, reproduced on the 8-device emulated
+CPU mesh):
+
+- :class:`DeviceLoss` — deterministic device-loss injection: raises
+  :class:`DeviceLossError` naming the lost device ids at a chosen
+  step-boundary poll, the exception
+  :func:`~apex_tpu.resilience.elastic.run_elastic_training` responds to
+  by rebuilding on the surviving submesh (a real deployment maps its
+  platform's device-failure signal to the same exception);
+- :func:`corrupt_shard` — flip a byte inside one rank's partition file
+  of a *sharded* checkpoint, so exactly that shard's CRC32 check fails
+  and the resilient restore walks back to the newest intact shard set;
+- :func:`slow_collective` — wrap a step function so one chosen step
+  stalls (a straggling/hung collective); the watchdog's deadline must
+  fire and escalate.
+
 Test-only by design: nothing here is imported by production modules, and
 the hook slot is cleared by the context managers (plus the test harness's
 chaos fixture) even when the simulated crash propagates.
@@ -143,6 +159,72 @@ def flip_packed_leaf_byte(ckpt_dir: str, step: int, key: str) -> None:
     nbytes = int(np.prod(entry["shape"] or [1])) * dt.itemsize
     _flip_byte(os.path.join(d, _ckpt._PACK),
                entry["offset"] + max(0, nbytes // 2))
+
+
+class DeviceLossError(RuntimeError):
+    """One or more mesh devices disappeared (preempted chip, failed host).
+
+    Carries ``device_ids`` so the elastic harness knows which submesh
+    survives.  The chaos tier raises it deterministically
+    (:class:`DeviceLoss`); a real deployment raises it from its
+    platform's failure signal (e.g. mapping ``XlaRuntimeError`` device
+    errors at the step boundary)."""
+
+    def __init__(self, device_ids, detail: str = ""):
+        self.device_ids = sorted(getattr(d, "id", d) for d in device_ids)
+        msg = f"lost device(s) {self.device_ids}"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+class DeviceLoss:
+    """Deterministically lose device(s) at a chosen step boundary.
+
+    Hook :meth:`poll` into the train loop's ``on_step`` (like
+    :class:`SimulatedPreemption`); on the ``at_step``-th poll it raises
+    :class:`DeviceLossError` naming ``device_ids`` — once, so the
+    rebuilt run sails past the same global step."""
+
+    def __init__(self, at_step: int, device_ids):
+        self.at_step = at_step
+        self.device_ids = list(device_ids)
+        self.fired = False
+        self.polls = 0
+
+    def poll(self, *_args) -> None:
+        self.polls += 1
+        if not self.fired and self.polls >= self.at_step:
+            self.fired = True
+            raise DeviceLossError(self.device_ids,
+                                  detail=f"injected at poll {self.polls}")
+
+
+def corrupt_shard(ckpt_dir: str, step: int, rank: int) -> str:
+    """Flip one byte in rank ``rank``'s partition file of a sharded
+    checkpoint — exactly that shard's CRC32 verification must fail while
+    every other shard file stays intact.  Returns the damaged path."""
+    path = os.path.join(_ckpt.step_dir(ckpt_dir, step),
+                        _ckpt.shard_file(rank))
+    _flip_byte(path, os.path.getsize(path) // 2)
+    return path
+
+
+def slow_collective(step_fn, *, at_step: int, delay: float):
+    """Wrap ``step_fn`` so its ``at_step``-th invocation stalls ``delay``
+    seconds before stepping — a straggling (or hung, for large
+    ``delay``) collective as seen from the host.  The watchdog armed
+    around the step must overrun and escalate."""
+    calls = {"n": 0}
+
+    def wrapped(state, batch):
+        calls["n"] += 1
+        if calls["n"] == at_step:
+            time.sleep(delay)
+        return step_fn(state, batch)
+
+    wrapped.calls = calls
+    return wrapped
 
 
 class SimulatedPreemption:

@@ -37,6 +37,7 @@ class GracePeriodHandler:
         self.signals = tuple(signals)
         self._stop = threading.Event()
         self._signum: Optional[int] = None
+        self._reason: Optional[str] = None
         self._count = 0
         self._prev: dict = {}
         self._installed = False
@@ -60,20 +61,26 @@ class GracePeriodHandler:
 
     @property
     def reason(self) -> Optional[str]:
-        """Why stop was requested: signal name, "requested", or None."""
+        """Why stop was requested: signal name, the caller-supplied
+        :meth:`request_stop` reason, "requested", or None."""
         if not self._stop.is_set():
             return None
         if self._signum is None:
-            return "requested"
+            return self._reason or "requested"
         try:
             return signal.Signals(self._signum).name
         except ValueError:  # pragma: no cover — unknown signal number
             return f"signal {self._signum}"
 
-    def request_stop(self) -> None:
+    def request_stop(self, reason: Optional[str] = None) -> None:
         """Programmatic preemption: same effect as receiving a signal.
-        Used by tests/chaos and by schedulers that know shutdown is coming
-        (e.g. a maintenance-event notification poller)."""
+        Used by tests/chaos, by schedulers that know shutdown is coming
+        (e.g. a maintenance-event notification poller), and by the
+        collective watchdog's escalation
+        (:class:`~apex_tpu.resilience.elastic.Watchdog`) — ``reason``
+        makes the *source* of the stop visible in logs/LoopResult."""
+        if reason is not None and not self._stop.is_set():
+            self._reason = reason
         self._stop.set()
 
     def reset(self) -> None:
@@ -81,6 +88,7 @@ class GracePeriodHandler:
         continue anyway)."""
         self._stop.clear()
         self._signum = None
+        self._reason = None
         self._count = 0
 
     def wait(self, timeout: Optional[float] = None) -> bool:

@@ -6,7 +6,15 @@ truncation) that trust loses the whole run.  :func:`restore_resilient`
 walks complete checkpoints newest-first, verifies each against its
 manifest CRC32 digests, and restores the newest *intact* one — reporting
 every corrupt step it skipped via ``warnings.warn`` so the incident is
-visible in logs, not silent."""
+visible in logs, not silent.
+
+Sharded (format-3, ``shard_axis``) checkpoints verify per-rank: every
+``shard_<r>.npz`` partition file is hashed against its own manifest
+digest, so one damaged shard condemns exactly that step and the walk
+falls back to the newest step whose *whole shard set* is intact.
+Cross-topology restore rides along: the target's shard count decides
+the N→M re-partition (``restore_checkpoint``'s reshard contract), so a
+fallback restore onto a shrunken mesh needs no extra plumbing."""
 
 from __future__ import annotations
 

@@ -15,6 +15,7 @@ from apex_tpu.transformer.testing.flagship import (  # noqa: F401
     FlagshipSetup,
     ZeroFitPlan,
     build_flagship_train_step,
+    flagship_elastic_build,
     flagship_state_bytes,
     gpt1p3b_config,
     gpt_param_count,

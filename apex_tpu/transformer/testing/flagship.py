@@ -65,6 +65,7 @@ __all__ = [
     "gpt_param_count",
     "flagship_state_bytes",
     "build_flagship_train_step",
+    "flagship_elastic_build",
     "FlagshipSetup",
 ]
 
@@ -169,6 +170,11 @@ class FlagshipSetup(NamedTuple):
     opt: DistributedFusedAdam
     model: GPTModel
     plan: ZeroFitPlan
+    # structure-prefix PartitionSpecs for the (params, opt_state) state
+    # tuple: params replicated, every opt_state leaf led by the "data"
+    # axis — exactly what save_checkpoint(shard_axis="data") needs to
+    # write per-rank partition files (resilience/elastic.py)
+    shardings: Any = None
 
 
 def build_flagship_train_step(
@@ -238,4 +244,36 @@ def build_flagship_train_step(
     step = jax.jit(sharded,
                    donate_argnums=(0, 1) if donate else ())
     return FlagshipSetup(step, params, opt_state, mesh, schema, opt,
-                         model, plan)
+                         model, plan, shardings=(P(), P("data")))
+
+
+def flagship_elastic_build(cfg: GPTConfig, *, plan: str | ZeroFitPlan
+                           = "bf16_fit", lr: float = 1e-4,
+                           seed: int = 0, donate: bool = False,
+                           on_loss=None):
+    """``build(devices)`` factory for
+    :func:`apex_tpu.resilience.run_elastic_training`: each call stands up
+    the ZeRO flagship step on exactly ``devices`` (a fresh mesh whose
+    "data" axis spans them) and adapts it to the resilient-loop contract
+    — ``state`` is the ``(params, opt_state)`` tuple (leading
+    ``[len(devices)]`` shard axis on every opt leaf, so it doubles as
+    the cross-topology restore target) and ``step_fn(state, (tokens,
+    labels))`` returns ``(state, None)``.  ``on_loss(step_loss)`` taps
+    the per-step loss for trajectory assertions."""
+
+    def build(devices):
+        fs = build_flagship_train_step(cfg, plan=plan, lr=lr,
+                                       devices=list(devices), seed=seed,
+                                       donate=donate)
+
+        def step_fn(state, batch):
+            p, s = state
+            tokens, labels = batch
+            p, s, loss = fs.step(p, s, tokens, labels)
+            if on_loss is not None:
+                on_loss(float(loss))
+            return (p, s), None
+
+        return step_fn, (fs.params, fs.opt_state), fs.shardings
+
+    return build

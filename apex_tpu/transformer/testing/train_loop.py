@@ -45,15 +45,21 @@ def run_resilient_training(
     keep: Optional[int] = None,
     async_saves: bool = True,
     shardings: Any = None,
+    shard_axis: Optional[str] = None,
     handler: Optional[GracePeriodHandler] = None,
     guard: Optional[StepGuard] = None,
+    watchdog: Any = None,
     start_step: int = 0,
     on_step: Optional[Callable[[int], None]] = None,
+    log_every: int = 0,
+    log_fn: Optional[Callable[[str], None]] = None,
 ) -> LoopResult:
     """Run ``step_fn`` over ``batches`` with the full resilience wiring.
 
     - every ``save_every`` steps: checkpoint (async by default — the loop
       keeps stepping while the write is in flight; the next save fences);
+      ``shard_axis`` makes every save *sharded* (per-rank partition files
+      for leaves whose spec leads with that axis — the ZeRO layout);
     - after every step: poll ``handler.should_stop``; on preemption write a
       final BLOCKING checkpoint (itself fencing any in-flight async write)
       and return with ``preempted=True`` — the caller restarts later via
@@ -61,8 +67,17 @@ def run_resilient_training(
       remaining batches with ``start_step`` set;
     - ``guard`` counts skipped steps from the ``finite`` flag ``step_fn``
       returns and raises after too many consecutive skips;
+    - ``watchdog`` (:class:`apex_tpu.resilience.Watchdog`) arms its
+      deadline around each ``step_fn`` call — the collective-bearing
+      region; a hang escalates to ``handler``'s save-and-exit path;
+    - ``log_every``/``log_fn`` emit a status line every N steps that
+      surfaces divergence-skip accounting — the guard's total/consecutive
+      skip counters and, when the state carries a
+      ``LossScaleState.skipped`` device counter (``state.scaler_state``),
+      that too — so skip events are visible without reading the pytree;
     - ``on_step(step)`` runs at each step boundary *before* the preemption
-      poll (the chaos harness's ``SimulatedPreemption.poll`` hooks here);
+      poll (the chaos harness's ``SimulatedPreemption.poll`` and
+      ``DeviceLoss.poll`` hook here);
     - before returning (any path) the loop fences on outstanding async
       writes, so a completed run's checkpoints are durable.
     """
@@ -76,17 +91,40 @@ def run_resilient_training(
         if ckpt_dir is None:
             return
         ckpt.save_checkpoint(ckpt_dir, state, step=step, keep=keep,
-                             shardings=shardings,
+                             shardings=shardings, shard_axis=shard_axis,
                              blocking=blocking or not async_saves)
         last_saved = step
 
+    def _log() -> None:
+        emit = log_fn or print
+        parts = [f"[resilient] step {step}"]
+        if guard is not None:
+            parts.append(f"skipped {guard.total_skipped}/"
+                         f"{guard.total_steps} (consecutive "
+                         f"{guard.consecutive})")
+        scaler_state = getattr(state, "scaler_state", None)
+        skipped = getattr(scaler_state, "skipped", None)
+        if skipped is not None:
+            import jax as _jax
+
+            parts.append(f"scaler_skipped {int(_jax.device_get(skipped))}")
+        if last_saved is not None:
+            parts.append(f"last_saved {last_saved}")
+        emit(" ".join(parts))
+
     try:
         for batch in batches:
-            state, finite = step_fn(state, batch)
+            if watchdog is not None:
+                with watchdog.step(step):
+                    state, finite = step_fn(state, batch)
+            else:
+                state, finite = step_fn(state, batch)
             step += 1
             steps_run += 1
             if guard is not None and finite is not None:
                 guard.update(finite)
+            if log_every and step % log_every == 0:
+                _log()
             if on_step is not None:
                 on_step(step)
             if handler is not None and handler.should_stop:

@@ -266,7 +266,12 @@ def _resnet_setup():
 
 
 def bench_resnet():
-    """Returns (images/sec, analytic TFLOPS, cost-analysis TFLOPS, loss)."""
+    """Returns (images/sec, analytic TFLOPS, cost-analysis TFLOPS, loss,
+    scaler-skipped step count).  The last is ``LossScaleState.skipped``
+    read off the final scale state — overflow-skipped steps surface in
+    the summary line instead of hiding in the state pytree (a bench
+    that silently skipped most of its steps would otherwise report a
+    great-looking loss)."""
     (train_step, params, bn_state, opt_state, scale_state,
      x, y) = _resnet_setup()
 
@@ -289,10 +294,12 @@ def bench_resnet():
         final_loss = float(loss)  # sync
         best_dt = min(best_dt, (time.perf_counter() - t0) / STEPS)
     assert jnp.isfinite(final_loss), f"training diverged: {final_loss}"
+    skipped = getattr(scale_state, "skipped", None)
+    skipped = int(jax.device_get(skipped)) if skipped is not None else 0
     ips = BATCH / best_dt
     analytic_tflops = ips * RN50_ANALYTIC_FLOPS_PER_IMG / 1e12
     cost_tflops = cost_flops / best_dt / 1e12
-    return ips, analytic_tflops, cost_tflops, final_loss
+    return ips, analytic_tflops, cost_tflops, final_loss, skipped
 
 
 GPT_L, GPT_H, GPT_V, GPT_SEQ = 24, 1024, 51200, 1024
@@ -530,9 +537,18 @@ def bench_gpt1p3b(roof):
                                 cfg.vocab_size)
     labels = jnp.roll(tokens, -1, axis=-1)
 
+    # divergence-skip accounting through the same StepGuard the train
+    # loops use (ISSUE 3): every non-finite step is COUNTED in the
+    # summary line, and a persistently-diverging bench dies with the
+    # guard's diagnostic instead of a bare assert at the end
+    from apex_tpu.resilience import StepGuard
+
+    guard = StepGuard(max_consecutive_skips=8)
+
     params, opt_state = fs.params, fs.opt_state
     params, opt_state, loss = fs.step(params, opt_state, tokens, labels)
     first_loss = float(loss)  # post-step-1 loss on the fixed batch
+    guard.update(bool(jnp.isfinite(first_loss)))
 
     steps = 4
     best_dt = float("inf")
@@ -542,6 +558,7 @@ def bench_gpt1p3b(roof):
             params, opt_state, loss = fs.step(params, opt_state, tokens,
                                               labels)
         final_loss = float(loss)  # sync
+        guard.update(bool(jnp.isfinite(final_loss)))
         best_dt = min(best_dt, (time.perf_counter() - t0) / steps)
     assert jnp.isfinite(final_loss), f"gpt1p3b diverged: {final_loss}"
 
@@ -556,6 +573,9 @@ def bench_gpt1p3b(roof):
         # 13 steps of Adam on one fixed batch must descend; recorded as
         # a boolean so the driver's record carries the claim explicitly
         "gpt1p3b_loss_decreasing": bool(final_loss < first_loss),
+        # StepGuard skip events (ISSUE 3): non-finite steps observed at
+        # the loop's sync points, visible without reading the pytree
+        "gpt1p3b_steps_skipped": guard.total_skipped,
     }
 
     # device-clock step time (the relay's host dispatch gap distorts
@@ -1260,10 +1280,14 @@ def main():
         extras["hbm_roof_gb_s"] = round(hbm, 1)
 
     note("resnet50...")
-    ips, rn_tflops, rn_cost_tflops, rn_loss = bench_resnet()
+    ips, rn_tflops, rn_cost_tflops, rn_loss, rn_skipped = bench_resnet()
     extras["resnet50_analytic_tflops"] = round(rn_tflops, 1)
     extras["resnet50_cost_analysis_tflops"] = round(rn_cost_tflops, 1)
     extras["resnet50_final_loss"] = round(rn_loss, 3)
+    # divergence-skip visibility (ISSUE 3): the amp scaler's monotonic
+    # skipped counter — a bench whose loss came from mostly-skipped
+    # steps must say so in the summary line
+    extras["resnet50_scaler_skipped"] = rn_skipped
     if roof is not None:
         extras["resnet50_mfu_vs_roof"] = round(rn_tflops / roof, 3)
 

@@ -61,6 +61,11 @@ def _extra_args(parser):
     g.add_argument("--vocab-size", type=int, default=51200,
                    help="unpadded vocab; padded to "
                         "--make-vocab-size-divisible-by x tp")
+    g.add_argument("--watchdog-timeout", type=float, default=0.0,
+                   help="seconds a train step (its collectives included) "
+                        "may run before the collective watchdog logs a "
+                        "straggler diagnostic and escalates to the "
+                        "grace-period save-and-exit path; 0 disables")
     return parser
 
 
@@ -195,11 +200,23 @@ def main(argv=None):
     loss = None
     preempted = False
     with resilience.GracePeriodHandler() as preempt:
+        # the watchdog arms a deadline around each collective-bearing
+        # step; a hang/straggler logs per-device heartbeats + duration
+        # percentiles and lands in the same grace-period exit as SIGTERM
+        watchdog = (resilience.Watchdog(args.watchdog_timeout,
+                                        handler=preempt)
+                    if args.watchdog_timeout > 0 else None)
         for it in range(step0, args.train_iters):
             tokens, labels = next(batches)
             rng = jax.random.fold_in(jax.random.PRNGKey(args.seed + 2), it)
-            params, opt_state, loss = train_step(params, opt_state, tokens,
-                                                 labels, rng)
+            if watchdog is not None:
+                with watchdog.step(it):
+                    params, opt_state, loss = train_step(
+                        params, opt_state, tokens, labels, rng)
+                    loss.block_until_ready()
+            else:
+                params, opt_state, loss = train_step(params, opt_state,
+                                                     tokens, labels, rng)
             if (it + 1) % args.log_interval == 0:
                 dt = (time.perf_counter() - t0) / args.log_interval
                 tok_s = args.global_batch_size * args.seq_length / dt
@@ -224,6 +241,8 @@ def main(argv=None):
                 # next save (or exit) fences on it
                 ckpt.save_checkpoint(args.save, (params, opt_state),
                                      step=it + 1, blocking=False)
+        if watchdog is not None:
+            watchdog.close()
     if args.save and not preempted and not (
             args.save_interval
             and args.train_iters % args.save_interval == 0):

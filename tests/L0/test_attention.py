@@ -149,6 +149,60 @@ class TestFlashAttention:
         # an unalignable shape yields None (generic path)
         assert pick(8, 1000, 16, 64, 512, True, 0.0, jnp.bfloat16) is None
 
+    @pytest.mark.slow  # interpret-mode packed-QKV kernels, like its sibling
+    def test_packed_qkv_lse_residual_is_logical_size(self):
+        # ADVICE r5: the attn_res remat policy used to save the raw
+        # [b, n_hg, group, n_b, 8, block] lse slab — an 8x residual from
+        # the sublane broadcast.  The fwd rule now slices row 0 before
+        # checkpoint_name; the residual must be logical-size (sublane
+        # dim 1) and the backward must consume it and still match the
+        # reference grads.
+        from apex_tpu.ops.attention import (
+            _flash_qkv_bwd_rule, _flash_qkv_fwd_rule)
+
+        b, s, nh, hn, block = 2, 64, 2, 64, 32  # group=2 at hn=64
+        scale = 1.0 / np.sqrt(hn)
+        qkv = jax.random.normal(jax.random.PRNGKey(0),
+                                (b, s, nh * 3 * hn), jnp.float32)
+        ctx, res = _flash_qkv_fwd_rule(qkv, 0, nh, hn, scale, True,
+                                       block, 0.0)
+        lse = res[3]
+        n_hg, group, n_b = 1, 2, s // block
+        assert lse.shape == (b, n_hg, group, n_b, 1, block), lse.shape
+
+        dctx = jax.random.normal(jax.random.PRNGKey(1), (b, s, nh * hn),
+                                 jnp.float32)
+        dqkv, _ = _flash_qkv_bwd_rule(nh, hn, scale, True, block, 0.0,
+                                      res, dctx)
+
+        def loss_ref(qkv):
+            q, k, v = _unpack_qkv(qkv, nh, hn)
+            out = _naive(q, k, v, causal=True)
+            out = out.transpose(0, 2, 1, 3).reshape(b, s, nh * hn)
+            return jnp.sum(out * dctx)
+
+        dref = jax.grad(loss_ref)(qkv)
+        np.testing.assert_allclose(dqkv, dref, rtol=1e-3, atol=1e-4)
+
+    def test_bwd_tiles_gate_lane_alignment(self, monkeypatch):
+        # ADVICE r5: the unrolled-tiles backward slices lse on the LANE
+        # dim at offsets qi = qb*block_q — unaligned for sub-128 blocks
+        # with more than one q-block; such shapes must route to the grid
+        # fallback, while single-q-block and 128-multiple blocks keep
+        # the tiles kernel.
+        from apex_tpu.ops import attention as attn_mod
+
+        monkeypatch.setattr(attn_mod.jax, "default_backend",
+                            lambda: "tpu")
+        sd = lambda sq: jax.ShapeDtypeStruct((4, sq, 64), jnp.bfloat16)
+        ok = attn_mod._bwd_tiles_ok
+        # block_q=16 with sq=64 -> 4 q-blocks at lane-unaligned offsets
+        assert not ok(sd(64), sd(64), None, 16, 16)
+        # sq == block_q: single q-block, offset 0 — allowed
+        assert ok(sd(64), sd(64), None, 64, 64)
+        # 128-multiple block with several q-blocks — allowed
+        assert ok(sd(512), sd(512), None, 128, 128)
+
     def test_causal_sq_longer_than_sk(self):
         # causal cross-attention with sq > sk: the leading q rows attend
         # to nothing (fully masked) — the unrolled-tiles kernels must
