@@ -58,6 +58,7 @@ from apex_tpu.resilience.guards import (  # noqa: F401
     DivergenceError,
     StepGuard,
     first_nonfinite_leaf,
+    global_grad_norm,
 )
 from apex_tpu.resilience.preemption import GracePeriodHandler  # noqa: F401
 from apex_tpu.resilience.restore import (  # noqa: F401
@@ -77,6 +78,7 @@ __all__ = [
     "Watchdog",
     "WatchdogTimeout",
     "first_nonfinite_leaf",
+    "global_grad_norm",
     "in_flight",
     "largest_divisor_submesh",
     "restore_resilient",

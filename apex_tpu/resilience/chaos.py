@@ -70,11 +70,16 @@ class FaultyStore:
     def __init__(self, *, fail_events: Iterable[str] = (),
                  fail_times: Optional[int] = 0,
                  delay: float = 0.0,
-                 delay_events: Iterable[str] = ("write_arrays",)):
+                 delay_events: Iterable[str] = ("write_arrays",),
+                 telemetry=None):
         self.fail_events = frozenset(fail_events)
         self.fail_times = fail_times
         self.delay = delay
         self.delay_events = frozenset(delay_events)
+        # optional TelemetryBus: each injected failure emits a typed
+        # `fault_injected` event, so a chaos run's stream shows WHICH
+        # fault produced the retries/fallbacks it also records
+        self.telemetry = telemetry
         self.calls: dict = {}
         self.failures_injected = 0
         self._lock = threading.Lock()
@@ -91,6 +96,9 @@ class FaultyStore:
         if self.delay and event in self.delay_events:
             time.sleep(self.delay)
         if should_fail:
+            if self.telemetry is not None:
+                self.telemetry.emit("fault_injected", kind="storage",
+                                    event=event, path=path)
             raise InjectedStorageError(
                 f"injected fault at {event} ({path})")
 
@@ -186,9 +194,10 @@ class DeviceLoss:
     :class:`DeviceLossError` naming ``device_ids`` — once, so the
     rebuilt run sails past the same global step."""
 
-    def __init__(self, at_step: int, device_ids):
+    def __init__(self, at_step: int, device_ids, *, telemetry=None):
         self.at_step = at_step
         self.device_ids = list(device_ids)
+        self.telemetry = telemetry
         self.fired = False
         self.polls = 0
 
@@ -196,6 +205,12 @@ class DeviceLoss:
         self.polls += 1
         if not self.fired and self.polls >= self.at_step:
             self.fired = True
+            if self.telemetry is not None:
+                self.telemetry.emit(
+                    "fault_injected", kind="device_loss",
+                    device_ids=[getattr(d, "id", d)
+                                for d in self.device_ids],
+                    at_poll=self.polls)
             raise DeviceLossError(self.device_ids,
                                   detail=f"injected at poll {self.polls}")
 
@@ -237,10 +252,12 @@ class SimulatedPreemption:
     ``use_signal=False`` or off the main thread, calls
     ``handler.request_stop()`` directly."""
 
-    def __init__(self, at_poll: int, *, handler=None, use_signal: bool = True):
+    def __init__(self, at_poll: int, *, handler=None, use_signal: bool = True,
+                 telemetry=None):
         self.at_poll = at_poll
         self.handler = handler
         self.use_signal = use_signal
+        self.telemetry = telemetry
         self.polls = 0
         self.fired = False
 
@@ -249,6 +266,10 @@ class SimulatedPreemption:
         if self.fired or self.polls < self.at_poll:
             return
         self.fired = True
+        if self.telemetry is not None:
+            self.telemetry.emit("fault_injected", kind="preemption",
+                                at_poll=self.polls,
+                                use_signal=bool(self.use_signal))
         if (self.use_signal
                 and threading.current_thread() is threading.main_thread()):
             os.kill(os.getpid(), signal.SIGTERM)

@@ -112,10 +112,16 @@ class Watchdog:
     def __init__(self, timeout, *, handler=None,
                  on_hang: Optional[Callable[[dict], None]] = None,
                  devices: Optional[Sequence] = None,
-                 history: int = 256, poll_interval: Optional[float] = None):
+                 history: int = 256, poll_interval: Optional[float] = None,
+                 telemetry=None):
         self.timeout = timeout
         self.handler = handler
         self.on_hang = on_hang
+        # optional TelemetryBus: every fire emits a typed `watchdog`
+        # event (the report rides the flight-recorder ring into any
+        # postmortem); emitted from the monitor thread — the bus is
+        # thread-safe by contract
+        self.telemetry = telemetry
         if devices is None:
             import jax
 
@@ -198,6 +204,16 @@ class Watchdog:
         """Duration percentiles over the retained step history."""
         return _percentiles(self.durations)
 
+    def max_heartbeat_age(self) -> Optional[float]:
+        """Age in seconds of the STALEST live device's last heartbeat
+        (None before any step completes).  The log-line stall signal:
+        a climbing age means the mesh stopped completing steps well
+        before the deadline escalates."""
+        now = time.monotonic()
+        ages = [now - t for d, t in self.last_beat.items()
+                if t is not None and d not in self.lost]
+        return max(ages) if ages else None
+
     def report(self) -> dict:
         """Straggler diagnostic: per-device heartbeat age + percentiles."""
         now = time.monotonic()
@@ -253,6 +269,11 @@ class Watchdog:
         self.last_report = report
         log.error("watchdog: step %d overran its %.3gs deadline — %s",
                   step, report["timeout"], report)
+        if self.telemetry is not None:
+            try:
+                self.telemetry.emit("watchdog", step=step, report=report)
+            except Exception:  # pragma: no cover — never break escalation
+                log.exception("watchdog telemetry emit failed")
         if self.on_hang is not None:
             self.on_hang(report)
         elif self.handler is not None:
@@ -377,7 +398,10 @@ def run_elastic_training(
     select_devices: Optional[Callable[[list], list]] = None,
     start_step: int = 0,
     on_step: Optional[Callable[[int], None]] = None,
+    log_every: int = 0,
     log_fn: Optional[Callable[[str], None]] = None,
+    telemetry=None,
+    telemetry_scalars=None,
 ):
     """Drive ZeRO training across device loss.
 
@@ -416,6 +440,15 @@ def run_elastic_training(
     than ``min_devices`` survive.  Preemption/watchdog escalation
     behave exactly as in the inner loop: final blocking (sharded) save,
     clean exit with ``preempted=True``.
+
+    ``telemetry`` (:class:`apex_tpu.telemetry.TelemetryBus`): on top of
+    the inner loop's events, each recovery emits ``device_loss`` (lost
+    ids, survivor count) and ``ckpt_restore`` (resumed step, restore
+    wall), books rebuild/restore time against goodput, and re-stamps
+    the bus's mesh topology with the survivor submesh so post-recovery
+    events are attributable to the shrunken mesh.  The inner loop's
+    exception path has already flushed a ``postmortem_*.jsonl`` by the
+    time the rebuild starts.
     """
     from apex_tpu.checkpoint.checkpoint import _complete_steps
     from apex_tpu.resilience.chaos import DeviceLossError
@@ -436,7 +469,9 @@ def run_elastic_training(
                 ckpt_dir=ckpt_dir, save_every=save_every, keep=keep,
                 shardings=shardings, shard_axis=shard_axis,
                 handler=handler, guard=guard, watchdog=watchdog,
-                start_step=step, on_step=on_step, log_fn=log_fn)
+                start_step=step, on_step=on_step,
+                log_every=log_every, log_fn=log_fn,
+                telemetry=telemetry, telemetry_scalars=telemetry_scalars)
             loop_results.append(result)
             return ElasticResult(
                 state=result.state, step=result.step, restarts=restarts,
@@ -451,6 +486,16 @@ def run_elastic_training(
             if select_devices is not None:
                 survivors = list(select_devices(survivors))
             restarts += 1
+            if telemetry is not None:
+                # no step stamp: the loss surfaced as an exception, so
+                # the exact faulting step lives in the inner loop's
+                # postmortem (already flushed), not here
+                telemetry.emit(
+                    "device_loss",
+                    device_ids=sorted(lost_ids),
+                    survivors=len(survivors), restarts=restarts,
+                    recoverable=(restarts <= max_restarts
+                                 and len(survivors) >= max(1, min_devices)))
             if restarts > max_restarts:
                 raise
             if len(survivors) < max(1, min_devices):
@@ -464,9 +509,27 @@ def run_elastic_training(
             emit(f"[elastic] lost device(s) {sorted(lost_ids)} — "
                  f"rebuilding on {len(devices)} survivors "
                  f"(restart {restarts}/{max_restarts})")
+            t_rebuild = time.monotonic()
             step_fn, state, shardings = build(devices)
+            if telemetry is not None:
+                telemetry.accountant().pause(
+                    time.monotonic() - t_rebuild, "rebuild")
+                telemetry.set_mesh({
+                    "n_devices": len(devices),
+                    "platform": getattr(devices[0], "platform", "unknown")
+                    if devices else "none",
+                    "lost_devices": sorted(lost)})
             if _complete_steps(ckpt_dir):
+                t_restore = time.monotonic()
                 state, step = restore_zero_checkpoint(ckpt_dir, state)
+                if telemetry is not None:
+                    telemetry.accountant().pause(
+                        time.monotonic() - t_restore, "restore")
+                    telemetry.emit(
+                        "ckpt_restore", step=step,
+                        wall_ms=round((time.monotonic() - t_restore) * 1e3,
+                                      3),
+                        n_shards=len(devices), reason="device_loss")
                 if step < start_step:
                     # the caller only holds batches for steps >=
                     # start_step; a negative batches slice would

@@ -33,6 +33,31 @@ class DivergenceError(RuntimeError):
     """Training skipped too many consecutive steps on non-finite values."""
 
 
+def global_grad_norm(tree: Any) -> Optional[float]:
+    """Host-side global L2 norm over every floating leaf of ``tree``
+    (nan/inf propagate — a diverged tree reports ``nan``/``inf``, which
+    is exactly the diagnostic).  Only call on the failure path: this
+    device_gets every leaf."""
+    if tree is None:
+        return None
+    total = 0.0
+    seen = False
+    for leaf in jax.tree_util.tree_leaves(tree):
+        arr = np.asarray(jax.device_get(leaf))
+        if not np.issubdtype(arr.dtype, np.floating):
+            try:  # bf16/fp8 (ml_dtypes) are floating but np disagrees
+                import jax.numpy as jnp
+
+                if not jnp.issubdtype(arr.dtype, jnp.floating):
+                    continue
+                arr = arr.astype(np.float32)
+            except Exception:
+                continue
+        seen = True
+        total += float(np.sum(np.square(arr.astype(np.float64))))
+    return float(np.sqrt(total)) if seen else None
+
+
 def first_nonfinite_leaf(tree: Any) -> Optional[str]:
     """Human-readable description of the first leaf containing a non-finite
     value: ``"['dense']['w']: 3 nan, 1 inf (of 128)"``; None if clean.
@@ -68,6 +93,11 @@ class StepGuard:
     ``finite`` scalar — the same sync the loop's logging already pays)."""
 
     max_consecutive_skips: int = 8
+    #: optional :class:`~apex_tpu.telemetry.TelemetryBus` — every skip
+    #: is then emitted as a typed ``skip`` event (grad-norm + loss
+    #: scale included), so divergence shows up in the structured stream
+    #: and the crash flight recorder, not just in counters
+    telemetry: Any = None
     consecutive: int = dataclasses.field(default=0, init=False)
     total_skipped: int = dataclasses.field(default=0, init=False)
     total_steps: int = dataclasses.field(default=0, init=False)
@@ -78,20 +108,42 @@ class StepGuard:
         returns ``finite``; feed that to :meth:`update` instead."""
         return tree_isfinite(tree)
 
-    def update(self, finite, tree: Any = None) -> bool:
+    def update(self, finite, tree: Any = None, *,
+               loss_scale: Any = None, step: Optional[int] = None) -> bool:
         """Record one step's outcome; returns True if the step was skipped.
 
-        ``tree`` (typically the grads) is only touched on the raise path,
-        to name the first non-finite leaf in the diagnostic."""
+        ``tree`` (typically the grads) is only touched on the skip path,
+        to compute the global grad-norm and (on the raise path) name the
+        first non-finite leaf.  ``loss_scale`` — the current scale
+        (device scalar or float), device_get only on the skip path.
+        ``step`` stamps the emitted ``skip`` event."""
         self.total_steps += 1
         if bool(finite):
             self.consecutive = 0
             return False
         self.consecutive += 1
         self.total_skipped += 1
+        # skip-path diagnostics (skips are rare; host syncs are fine here)
+        scale = None
+        if loss_scale is not None:
+            try:
+                scale = float(jax.device_get(loss_scale))
+            except Exception:
+                pass
+        gnorm = global_grad_norm(tree)
+        if self.telemetry is not None:
+            self.telemetry.emit(
+                "skip", step=step, consecutive=self.consecutive,
+                total_skipped=self.total_skipped,
+                total_steps=self.total_steps,
+                grad_norm=gnorm, loss_scale=scale)
         if 0 < self.max_consecutive_skips <= self.consecutive:
             culprit = first_nonfinite_leaf(tree) if tree is not None else None
             where = f" — first non-finite leaf: {culprit}" if culprit else ""
+            if gnorm is not None:
+                where += f"; global grad-norm {gnorm:.6g}"
+            if scale is not None:
+                where += f"; loss scale {scale:g}"
             raise DivergenceError(
                 f"{self.consecutive} consecutive steps produced non-finite "
                 f"values ({self.total_skipped}/{self.total_steps} steps "

@@ -1,0 +1,75 @@
+"""Training telemetry: metrics bus, goodput accounting, flight recorder.
+
+The *online* observability layer (ISSUE 4) — the offline half (trace
+capture, per-op attribution) is :mod:`apex_tpu.profiling`:
+
+- **bus** — :class:`TelemetryBus` with a closed set of typed events
+  (:data:`EVENT_TYPES`) and pluggable sinks (:class:`JsonlSink`,
+  :class:`MemorySink`, :class:`StdoutSink`); every event stamped with
+  run id, step, monotonic time, and mesh topology;
+- **accounting** — :class:`StepAccountant` splits wall time into
+  data-wait / step / checkpoint-fence (+ restore / rebuild / compile)
+  buckets, batches scalar fetches into one ``device_get`` per logging
+  window, and computes **goodput** (productive-step fraction);
+- **flight recorder** — :class:`FlightRecorder` ring of the last N
+  events, flushed to ``postmortem_*.jsonl`` on SIGTERM, watchdog
+  escalation, or device loss (``bus.flush_postmortem``);
+- **schema** — :func:`validate_event` / :func:`validate_jsonl`, the
+  CI-side contract every producer is tested against;
+- **CLI** — ``python -m apex_tpu.telemetry summarize run.jsonl``
+  (p50/p95/p99 step time, goodput %, event counts, ``--diff`` A/B).
+
+See ``docs/telemetry.md`` for the event schema and wiring examples.
+"""
+
+from apex_tpu.telemetry.accounting import (  # noqa: F401
+    PAUSE_KINDS,
+    StepAccountant,
+)
+from apex_tpu.telemetry.bus import (  # noqa: F401
+    EVENT_TYPES,
+    JsonlSink,
+    MemorySink,
+    StdoutSink,
+    TelemetryBus,
+    TelemetryError,
+    default_mesh_topology,
+    install_recompile_listener,
+)
+from apex_tpu.telemetry.recorder import FlightRecorder  # noqa: F401
+from apex_tpu.telemetry.schema import (  # noqa: F401
+    SchemaError,
+    load_jsonl,
+    validate_event,
+    validate_events,
+    validate_jsonl,
+)
+from apex_tpu.telemetry.summarize import (  # noqa: F401
+    format_diff,
+    format_summary,
+    summarize_events,
+    summarize_file,
+)
+
+__all__ = [
+    "EVENT_TYPES",
+    "FlightRecorder",
+    "JsonlSink",
+    "MemorySink",
+    "PAUSE_KINDS",
+    "SchemaError",
+    "StdoutSink",
+    "StepAccountant",
+    "TelemetryBus",
+    "TelemetryError",
+    "default_mesh_topology",
+    "format_diff",
+    "format_summary",
+    "install_recompile_listener",
+    "load_jsonl",
+    "summarize_events",
+    "summarize_file",
+    "validate_event",
+    "validate_events",
+    "validate_jsonl",
+]

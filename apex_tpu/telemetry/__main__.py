@@ -1,0 +1,72 @@
+"""CLI: ``python -m apex_tpu.telemetry summarize run.jsonl [--diff b.jsonl]``.
+
+Subcommands:
+
+- ``summarize RUN.jsonl`` — step-time p50/p95/p99, goodput %, time
+  buckets, per-event-type counts.  ``--diff OTHER.jsonl`` renders an
+  A/B table instead (RUN is the A/baseline column).  ``--json`` emits
+  the raw summary record(s) for tooling.
+- ``validate FILE.jsonl`` — schema-check every event (exit 1 on the
+  first violation); works on run streams and postmortem files alike.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Sequence
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m apex_tpu.telemetry",
+        description="Telemetry stream tools (see docs/telemetry.md)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_sum = sub.add_parser("summarize",
+                           help="aggregate one run (or A/B-diff two)")
+    p_sum.add_argument("jsonl", help="telemetry JSONL stream")
+    p_sum.add_argument("--diff", metavar="OTHER",
+                       help="second stream: render an A/B table "
+                            "(JSONL = A/baseline, OTHER = B)")
+    p_sum.add_argument("--json", action="store_true",
+                       help="emit the summary record(s) as JSON")
+
+    p_val = sub.add_parser("validate",
+                           help="schema-check every event in a file")
+    p_val.add_argument("jsonl")
+
+    args = parser.parse_args(argv)
+
+    if args.cmd == "validate":
+        from apex_tpu.telemetry.schema import SchemaError, validate_jsonl
+
+        try:
+            n = validate_jsonl(args.jsonl)
+        except SchemaError as e:
+            print(f"INVALID: {e}", file=sys.stderr)
+            return 1
+        print(f"ok: {n} events valid")
+        return 0
+
+    from apex_tpu.telemetry.summarize import (
+        format_diff, format_summary, summarize_file)
+
+    summary = summarize_file(args.jsonl)
+    if args.diff:
+        other = summarize_file(args.diff)
+        if args.json:
+            print(json.dumps({"a": summary, "b": other}, indent=1))
+        else:
+            print(format_diff(summary, other))
+        return 0
+    if args.json:
+        print(json.dumps(summary, indent=1))
+    else:
+        print(format_summary(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,139 @@
+"""JSONL event-schema validator (CI/tooling tier).
+
+Telemetry is only useful if every producer agrees on the record shape —
+a stream a tool can't parse is a ``print`` with extra steps.  This is a
+small hand-rolled validator (no jsonschema dependency; the container
+rule is "stub or gate missing deps") enforcing:
+
+- the universal stamp every event carries (``type`` in
+  :data:`~apex_tpu.telemetry.bus.EVENT_TYPES`, ``run_id`` str,
+  ``step`` int-or-None, ``t``/``ts`` numbers, ``mesh`` dict);
+- per-type required payload fields with their types
+  (:data:`PAYLOAD_REQUIRED`);
+- JSON-serializability (an event that can't round-trip through
+  ``json`` would poison the sink file).
+
+Tests run every emitted event through :func:`validate_event`;
+:func:`validate_jsonl` checks a whole file (e.g. a postmortem).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterable, List
+
+from apex_tpu.telemetry.bus import EVENT_TYPES
+
+NUMBER = (int, float)
+
+#: Universal stamp: field -> allowed types (None allowed where noted).
+STAMP_REQUIRED: Dict[str, tuple] = {
+    "type": (str,),
+    "run_id": (str,),
+    "step": (int, type(None)),
+    "t": NUMBER,
+    "ts": NUMBER,
+    "mesh": (dict,),
+}
+
+#: Per-type required payload fields -> allowed types.
+PAYLOAD_REQUIRED: Dict[str, Dict[str, tuple]] = {
+    "run_start": {},
+    "run_end": {"goodput": NUMBER, "steps": (int,), "wall_s": NUMBER,
+                "reason": (str,)},
+    "step": {"step_ms": NUMBER},
+    "ckpt_save": {"blocking": (bool,)},
+    "ckpt_restore": {},
+    "skip": {"consecutive": (int,), "total_skipped": (int,)},
+    "watchdog": {"report": (dict,)},
+    "device_loss": {"device_ids": (list,)},
+    "recompile": {},
+    "fault_injected": {"kind": (str,)},
+    "timers": {"timers_ms": (dict,)},
+    "postmortem": {"reason": (str,), "ring_events": (int,)},
+}
+
+
+class SchemaError(ValueError):
+    """An event violates the telemetry schema."""
+
+
+def _type_names(types: tuple) -> str:
+    return "/".join(t.__name__ for t in types)
+
+
+def validate_event(event: Any) -> Dict[str, Any]:
+    """Validate one event dict; returns it (for chaining) or raises
+    :class:`SchemaError` naming the offending field."""
+    if not isinstance(event, dict):
+        raise SchemaError(f"event must be a dict, got {type(event).__name__}")
+    for field, types in STAMP_REQUIRED.items():
+        if field not in event:
+            raise SchemaError(f"missing stamp field {field!r}: {event}")
+        if not isinstance(event[field], types):
+            raise SchemaError(
+                f"stamp field {field!r} must be {_type_names(types)}, got "
+                f"{type(event[field]).__name__} ({event[field]!r})")
+    etype = event["type"]
+    if etype not in EVENT_TYPES:
+        raise SchemaError(
+            f"unknown event type {etype!r}; known: {sorted(EVENT_TYPES)}")
+    for field, types in PAYLOAD_REQUIRED[etype].items():
+        if field not in event:
+            raise SchemaError(
+                f"{etype} event missing required field {field!r}: {event}")
+        # bool is an int subclass; an int-typed field must not accept it
+        v = event[field]
+        if isinstance(v, bool) and bool not in types:
+            raise SchemaError(
+                f"{etype}.{field} must be {_type_names(types)}, got bool")
+        if not isinstance(v, types):
+            raise SchemaError(
+                f"{etype}.{field} must be {_type_names(types)}, got "
+                f"{type(v).__name__} ({v!r})")
+    try:
+        json.dumps(event)
+    except (TypeError, ValueError) as e:
+        raise SchemaError(f"event not JSON-serializable: {e}") from e
+    return event
+
+
+def validate_events(events: Iterable[Dict[str, Any]]) -> int:
+    """Validate an iterable of events; returns the count."""
+    n = 0
+    for ev in events:
+        validate_event(ev)
+        n += 1
+    return n
+
+
+def load_jsonl(path: str,
+               tolerate_torn_tail: bool = False) -> List[Dict[str, Any]]:
+    """Parse a telemetry/postmortem JSONL file (blank lines skipped).
+
+    ``tolerate_torn_tail`` — a SIGKILL/OOM-kill or ENOSPC can leave one
+    partial final line despite the sink's per-event flush; the
+    *summarize* path drops that torn last line instead of refusing the
+    stream (the crashed stream is exactly the one an operator most
+    needs summarized).  ``validate`` stays strict."""
+    out = []
+    with open(path) as f:
+        lines = f.readlines()
+    last_payload = max((i for i, ln in enumerate(lines, 1) if ln.strip()),
+                       default=0)
+    for i, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError as e:
+            if tolerate_torn_tail and i == last_payload:
+                break
+            raise SchemaError(f"{path}:{i}: not valid JSON: {e}") from e
+    return out
+
+
+def validate_jsonl(path: str) -> int:
+    """Validate every event in a JSONL file; returns the count."""
+    return validate_events(load_jsonl(path))

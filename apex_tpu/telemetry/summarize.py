@@ -1,0 +1,160 @@
+"""Offline aggregation of a telemetry JSONL stream.
+
+``python -m apex_tpu.telemetry summarize run.jsonl`` renders the
+operator's one-screen view of a run — step-time percentiles, goodput
+with its loss buckets, per-event-type counts — and ``--diff b.jsonl``
+turns two runs into an A/B table (the diffable-stream payoff: "did the
+new remat policy move p95, and did goodput pay for it?").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from apex_tpu.telemetry.schema import load_jsonl
+
+
+def percentile(sorted_vals: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted sequence."""
+    if not sorted_vals:
+        return float("nan")
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate a stream into the summary record.
+
+    Goodput comes from the last ``run_end`` event when the run exited
+    through its accounting (the accountant's ledger is authoritative —
+    it spans elastic restarts); a crashed stream without one falls back
+    to productive-step seconds over the stream's time extent.
+    """
+    counts: Dict[str, int] = {}
+    step_ms: List[float] = []
+    skipped_steps = 0
+    run_end: Optional[Dict[str, Any]] = None
+    t_lo = t_hi = None
+    for ev in events:
+        counts[ev.get("type", "?")] = counts.get(ev.get("type", "?"), 0) + 1
+        t = ev.get("t")
+        if isinstance(t, (int, float)):
+            t_lo = t if t_lo is None else min(t_lo, t)
+            t_hi = t if t_hi is None else max(t_hi, t)
+        if ev.get("type") == "step":
+            step_ms.append(float(ev.get("step_ms", 0.0)))
+            if ev.get("skipped"):
+                skipped_steps += 1
+        elif ev.get("type") == "run_end":
+            run_end = ev
+
+    s = sorted(step_ms)
+    run_ids = list(dict.fromkeys(
+        e.get("run_id") for e in events if e.get("run_id")))
+    out: Dict[str, Any] = {
+        "run_id": run_ids[0] if run_ids else None,
+        "n_events": len(events),
+        "counts": dict(sorted(counts.items())),
+        "steps": len(step_ms),
+        # a skipped step may surface twice (a `skip` event from the
+        # guard AND the skipped flag on its `step` event): take the max,
+        # never the sum
+        "skipped_steps": max(skipped_steps, counts.get("skip", 0)),
+        "step_ms_p50": round(percentile(s, 0.50), 3) if s else None,
+        "step_ms_p95": round(percentile(s, 0.95), 3) if s else None,
+        "step_ms_p99": round(percentile(s, 0.99), 3) if s else None,
+    }
+    if len(run_ids) > 1:
+        # JsonlSink appends: a restarted job continues its stream file
+        # under a new run_id.  Aggregating across runs is legitimate,
+        # but the record must say it happened.
+        out["run_ids"] = run_ids
+    if run_end is not None:
+        out["goodput"] = run_end.get("goodput")
+        out["buckets_s"] = run_end.get("buckets_s", {})
+        out["wall_s"] = run_end.get("wall_s")
+        out["steps_per_sec"] = run_end.get("steps_per_sec")
+        out["stop_reason"] = run_end.get("reason")
+    elif s and t_hi is not None and t_hi > t_lo:
+        productive_s = sum(
+            float(e.get("step_ms", 0.0)) for e in events
+            if e.get("type") == "step" and not e.get("skipped")) / 1e3
+        out["goodput"] = round(min(1.0, productive_s / (t_hi - t_lo)), 4)
+        out["wall_s"] = round(t_hi - t_lo, 3)
+        out["goodput_estimated"] = True  # no run_end: crashed stream
+    return out
+
+
+def summarize_file(path: str) -> Dict[str, Any]:
+    # tolerant load: a crashed stream may end in a torn line, and the
+    # crashed stream is the one that most needs summarizing
+    return summarize_events(load_jsonl(path, tolerate_torn_tail=True))
+
+
+def _pct(v) -> str:
+    return "n/a" if v is None else f"{100.0 * v:.1f}%"
+
+
+def _ms(v) -> str:
+    return "n/a" if v is None else f"{v:.1f}ms"
+
+
+def format_summary(s: Dict[str, Any]) -> str:
+    runs = s.get("run_ids")
+    lines = [
+        f"run {' + '.join(runs) if runs else s.get('run_id')}  "
+        f"events {s.get('n_events')}  "
+        f"steps {s.get('steps')} ({s.get('skipped_steps', 0)} skipped)",
+        f"step time   p50 {_ms(s.get('step_ms_p50'))}  "
+        f"p95 {_ms(s.get('step_ms_p95'))}  "
+        f"p99 {_ms(s.get('step_ms_p99'))}",
+        f"goodput     {_pct(s.get('goodput'))}"
+        + (" (estimated: no run_end)" if s.get("goodput_estimated") else ""),
+    ]
+    buckets = s.get("buckets_s")
+    if buckets:
+        lines.append("time split  " + "  ".join(
+            f"{k} {v:.2f}s" for k, v in sorted(buckets.items())))
+    if s.get("stop_reason"):
+        lines.append(f"stop        {s['stop_reason']}"
+                     + (f"  ({s.get('steps_per_sec')} steps/s)"
+                        if s.get("steps_per_sec") is not None else ""))
+    counts = s.get("counts", {})
+    if counts:
+        lines.append("events      " + "  ".join(
+            f"{k}={v}" for k, v in counts.items()))
+    return "\n".join(lines)
+
+
+#: Scalar rows the A/B diff table compares.
+_DIFF_ROWS = (
+    ("steps", "steps", "{:d}"),
+    ("skipped_steps", "skipped", "{:d}"),
+    ("step_ms_p50", "p50 (ms)", "{:.2f}"),
+    ("step_ms_p95", "p95 (ms)", "{:.2f}"),
+    ("step_ms_p99", "p99 (ms)", "{:.2f}"),
+    ("goodput", "goodput", "{:.3f}"),
+    ("steps_per_sec", "steps/s", "{:.3f}"),
+)
+
+
+def format_diff(a: Dict[str, Any], b: Dict[str, Any]) -> str:
+    """A/B comparison table of two summaries (A = first file, the
+    baseline; delta = B - A, with a ratio where it makes sense)."""
+    name_a = str(a.get("run_id"))[:24]
+    name_b = str(b.get("run_id"))[:24]
+    lines = [f"{'':<12} {'A: ' + name_a:>28} {'B: ' + name_b:>28} "
+             f"{'delta':>12}"]
+    for key, label, fmt in _DIFF_ROWS:
+        va, vb = a.get(key), b.get(key)
+        fa = fmt.format(va) if va is not None else "n/a"
+        fb = fmt.format(vb) if vb is not None else "n/a"
+        if va is not None and vb is not None:
+            d = vb - va
+            delta = f"{d:+.3f}" if isinstance(d, float) else f"{d:+d}"
+            if va not in (0, None) and key not in ("steps", "skipped_steps"):
+                delta += f" ({vb / va:.2f}x)"
+        else:
+            delta = "n/a"
+        lines.append(f"{label:<12} {fa:>28} {fb:>28} {delta:>12}")
+    return "\n".join(lines)

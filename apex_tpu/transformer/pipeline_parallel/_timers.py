@@ -56,10 +56,17 @@ class _Timer:
 
 
 class Timers:
-    """Group of named timers (reference _timers.py:42-83)."""
+    """Group of named timers (reference _timers.py:42-83).
 
-    def __init__(self):
+    ``telemetry`` — optional :class:`apex_tpu.telemetry.TelemetryBus`;
+    :meth:`log` then emits a structured ``timers`` event (name → ms
+    map) through the bus's sinks instead of printing a bare string.
+    The reference ``log`` API is preserved either way: same arguments,
+    same formatted string returned."""
+
+    def __init__(self, telemetry=None):
         self.timers: Dict[str, _Timer] = {}
+        self.telemetry = telemetry
 
     def __call__(self, name: str) -> _Timer:
         if name not in self.timers:
@@ -72,15 +79,26 @@ class Timers:
             value = self.timers[name].elapsed(reset=reset) / normalizer
             writer.add_scalar(name + "-time", value, iteration)
 
-    def log(self, names=None, normalizer: float = 1.0, reset: bool = True) -> str:
+    def log(self, names=None, normalizer: float = 1.0, reset: bool = True,
+            step: Optional[int] = None) -> str:
         if normalizer <= 0.0:
             raise ValueError("normalizer must be positive")
         names = names if names is not None else list(self.timers)
+        values = {
+            name: self.timers[name].elapsed(reset=reset) * 1000.0
+            / normalizer
+            for name in names
+        }
         string = "time (ms)"
-        for name in names:
-            t = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+        for name, t in values.items():
             string += f" | {name}: {t:.2f}"
-        print(string, flush=True)
+        if self.telemetry is not None:
+            self.telemetry.emit(
+                "timers", step=step,
+                timers_ms={k: round(v, 3) for k, v in values.items()},
+                normalizer=normalizer)
+        else:
+            print(string, flush=True)
         return string
 
 

@@ -8,6 +8,14 @@ the full survive-and-resume story on CPU; it is also the reference wiring
 for real entrypoints (``examples/gpt/pretrain_gpt.py`` follows the same
 shape).
 
+With a :class:`~apex_tpu.telemetry.TelemetryBus` attached the loop is
+also the reference *observability* wiring (ISSUE 4): per-step ``step``
+events with the data-wait / step / checkpoint-fence wall split,
+``ckpt_save`` events, ``skip`` events from the guard, ``watchdog``
+events from the deadline monitor, and a flight-recorder postmortem
+flushed on every abnormal exit (grace-period stop, watchdog escalation,
+device loss, divergence).
+
 Contract: ``step_fn(state, batch) -> (state, finite_or_None)`` where
 ``finite`` is the all-finite scalar of the step's grads (or None when the
 loop should not do skip accounting).
@@ -16,7 +24,8 @@ loop should not do skip accounting).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Iterable, Optional
+import time
+from typing import Any, Callable, Dict, Iterable, Optional
 
 from apex_tpu import checkpoint as ckpt
 from apex_tpu.resilience import wait_for_save
@@ -33,6 +42,24 @@ class LoopResult:
     stop_reason: Optional[str]
     last_saved_step: Optional[int]
     skipped_steps: int
+
+
+def _default_scalars(state: Any, finite: Any) -> Dict[str, Any]:
+    """Device-scalar refs the loop can surface without knowing the
+    state's shape: the amp scaler's loss scale and monotonic skip
+    counter (when the state carries them) plus the step's finite flag.
+    These are REFERENCES — the accountant batches the fetch, one
+    device_get per logging window."""
+    out: Dict[str, Any] = {}
+    scaler_state = getattr(state, "scaler_state", None)
+    if scaler_state is not None:
+        if getattr(scaler_state, "loss_scale", None) is not None:
+            out["loss_scale"] = scaler_state.loss_scale
+        if getattr(scaler_state, "skipped", None) is not None:
+            out["scaler_skipped"] = scaler_state.skipped
+    if finite is not None:
+        out["finite"] = finite
+    return out
 
 
 def run_resilient_training(
@@ -53,6 +80,8 @@ def run_resilient_training(
     on_step: Optional[Callable[[int], None]] = None,
     log_every: int = 0,
     log_fn: Optional[Callable[[str], None]] = None,
+    telemetry: Any = None,
+    telemetry_scalars: Optional[Callable[[Any], Dict[str, Any]]] = None,
 ) -> LoopResult:
     """Run ``step_fn`` over ``batches`` with the full resilience wiring.
 
@@ -70,11 +99,20 @@ def run_resilient_training(
     - ``watchdog`` (:class:`apex_tpu.resilience.Watchdog`) arms its
       deadline around each ``step_fn`` call — the collective-bearing
       region; a hang escalates to ``handler``'s save-and-exit path;
-    - ``log_every``/``log_fn`` emit a status line every N steps that
-      surfaces divergence-skip accounting — the guard's total/consecutive
-      skip counters and, when the state carries a
-      ``LossScaleState.skipped`` device counter (``state.scaler_state``),
-      that too — so skip events are visible without reading the pytree;
+    - ``log_every``/``log_fn`` emit a status line every N steps carrying
+      throughput (steps/s over the window), divergence-skip accounting
+      (the guard's counters and, when the state carries a
+      ``LossScaleState.skipped`` device counter, that too), and — with a
+      watchdog attached — the max heartbeat age, so a stalling mesh is
+      visible *before* the deadline escalates;
+    - ``telemetry`` (:class:`apex_tpu.telemetry.TelemetryBus`): the loop
+      emits ``run_start``/``step``/``ckpt_save``/``run_end`` events,
+      books the wall split (data-wait / step / ckpt-fence, for goodput),
+      shares its bus with ``guard``/``watchdog`` (skip and watchdog
+      events), and flushes a flight-recorder postmortem on the
+      grace-period exit and on any exception leaving the loop.
+      ``telemetry_scalars(state) -> {name: device_ref}`` adds run-
+      specific scalars (e.g. the loss) to the windowed batched fetch;
     - ``on_step(step)`` runs at each step boundary *before* the preemption
       poll (the chaos harness's ``SimulatedPreemption.poll`` and
       ``DeviceLoss.poll`` hook here);
@@ -86,18 +124,58 @@ def run_resilient_training(
     last_saved: Optional[int] = None
     preempted = False
 
+    acct = None
+    compile_acc = {"s": 0.0}  # XLA compile wall since the last step
+    uninstall_recompile = lambda: None  # noqa: E731
+    if telemetry is not None:
+        from apex_tpu.telemetry import install_recompile_listener
+
+        acct = telemetry.accountant(window=log_every or 10)
+        uninstall_recompile = install_recompile_listener(
+            telemetry,
+            on_duration=lambda s: compile_acc.__setitem__(
+                "s", compile_acc["s"] + s))
+        if guard is not None and guard.telemetry is None:
+            guard.telemetry = telemetry
+        if watchdog is not None:
+            telemetry.attach_watchdog(watchdog)
+        telemetry.emit(
+            "run_start", step=start_step,
+            save_every=save_every, async_saves=bool(async_saves),
+            sharded=shard_axis is not None,
+            watchdog=watchdog is not None, guarded=guard is not None)
+
     def _save(blocking: bool) -> None:
         nonlocal last_saved
         if ckpt_dir is None:
             return
+        t0 = time.monotonic()
         ckpt.save_checkpoint(ckpt_dir, state, step=step, keep=keep,
                              shardings=shardings, shard_axis=shard_axis,
                              blocking=blocking or not async_saves)
+        dt = time.monotonic() - t0
         last_saved = step
+        if telemetry is not None:
+            # the host-visible cost: a blocking save IS a fence+write;
+            # an async save call only stalls when it fences a previous
+            # in-flight write — either way `dt` is checkpoint stall
+            acct.pause(dt, "ckpt_fence")
+            telemetry.emit("ckpt_save", step=step,
+                           blocking=bool(blocking or not async_saves),
+                           wall_ms=round(dt * 1e3, 3))
+
+    t_last_log = time.monotonic()
+    step_last_log = start_step
 
     def _log() -> None:
+        nonlocal t_last_log, step_last_log
         emit = log_fn or print
         parts = [f"[resilient] step {step}"]
+        now = time.monotonic()
+        if now > t_last_log and step > step_last_log:
+            parts.append(
+                f"{(step - step_last_log) / (now - t_last_log):.2f} steps/s")
+        t_last_log, step_last_log = now, step
         if guard is not None:
             parts.append(f"skipped {guard.total_skipped}/"
                          f"{guard.total_steps} (consecutive "
@@ -108,12 +186,40 @@ def run_resilient_training(
             import jax as _jax
 
             parts.append(f"scaler_skipped {int(_jax.device_get(skipped))}")
+        if watchdog is not None:
+            age = watchdog.max_heartbeat_age()
+            if age is not None:
+                # the stall early-warning: this climbs for the whole
+                # hang, the deadline only fires at its end
+                parts.append(f"max_hb_age {age:.1f}s")
         if last_saved is not None:
             parts.append(f"last_saved {last_saved}")
         emit(" ".join(parts))
 
+    def _flush_postmortem(reason: str) -> None:
+        if telemetry is None:
+            return
+        try:
+            telemetry.flush_postmortem(reason, step=step, watchdog=watchdog)
+        except Exception:  # never mask the primary failure
+            pass
+
+    def _finish(reason: str) -> None:
+        if acct is not None:
+            try:
+                acct.finish(step=step, reason=reason)
+            except Exception:
+                pass
+
     try:
-        for batch in batches:
+        it = iter(batches)
+        while True:
+            t0 = time.monotonic()
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            t1 = time.monotonic()
             if watchdog is not None:
                 with watchdog.step(step):
                     state, finite = step_fn(state, batch)
@@ -121,8 +227,33 @@ def run_resilient_training(
                 state, finite = step_fn(state, batch)
             step += 1
             steps_run += 1
-            if guard is not None and finite is not None:
-                guard.update(finite)
+            skipped = False
+            synced = guard is not None and finite is not None
+            if synced:
+                scaler_state = getattr(state, "scaler_state", None)
+                # bool(finite) inside update is a device sync — the one
+                # per-step sync a guarded loop already pays
+                skipped = guard.update(
+                    finite, step=step,
+                    loss_scale=getattr(scaler_state, "loss_scale", None))
+            # measure step wall AFTER the guard's finite sync, so on an
+            # asynchronous backend step_ms covers the device step, not
+            # just host dispatch; an unguarded loop has no sync point
+            # and its step events are tagged timing="dispatch" — the
+            # stream must say which clock it is on
+            t2 = time.monotonic()
+            if acct is not None:
+                scalars = _default_scalars(state, finite)
+                if telemetry_scalars is not None:
+                    scalars.update(telemetry_scalars(state) or {})
+                # compile wall observed inside this step (first step,
+                # mid-run reshape) goes to the compile bucket, not to
+                # productive goodput
+                compile_s, compile_acc["s"] = compile_acc["s"], 0.0
+                acct.step_done(step, step_s=t2 - t1, data_wait_s=t1 - t0,
+                               skipped=skipped, scalars=scalars,
+                               compile_s=compile_s,
+                               timing="synced" if synced else "dispatch")
             if log_every and step % log_every == 0:
                 _log()
             if on_step is not None:
@@ -135,22 +266,39 @@ def run_resilient_training(
                 break
             if save_every and step % save_every == 0:
                 _save(blocking=False)
-    except BaseException:
-        # still fence, but never let a parked async-save error mask the
-        # primary exception (e.g. a DivergenceError diagnostic)
+    except BaseException as e:
+        # the crash path: dump the flight recorder FIRST (the postmortem
+        # is the whole point of the recorder), then fence — and never
+        # let a parked async-save error mask the primary exception
+        # (e.g. a DivergenceError diagnostic)
+        _flush_postmortem(type(e).__name__)
+        _finish(type(e).__name__)
         try:
             wait_for_save()
         except Exception:
             pass
         raise
+    finally:
+        uninstall_recompile()
+    t0 = time.monotonic()
     wait_for_save()
+    if acct is not None:
+        acct.pause(time.monotonic() - t0, "ckpt_fence")
+
+    stop_reason = handler.reason if handler is not None else None
+    if preempted:
+        # grace-period exit (SIGTERM / watchdog escalation /
+        # request_stop): leave the machine-readable record of the last
+        # ring-buffer window next to the stream
+        _flush_postmortem(stop_reason or "preempted")
+    _finish(stop_reason or "completed")
 
     return LoopResult(
         state=state,
         steps_run=steps_run,
         step=step,
         preempted=preempted,
-        stop_reason=handler.reason if handler is not None else None,
+        stop_reason=stop_reason,
         last_saved_step=last_saved,
         skipped_steps=guard.total_skipped if guard is not None else 0,
     )

@@ -221,6 +221,103 @@ def bench_hbm_roof():
 
 
 # ---------------------------------------------------------------------------
+# Workload telemetry (ISSUE 4): the whole-model benches emit a stream
+# ---------------------------------------------------------------------------
+
+
+class _BenchTelemetry:
+    """Telemetry stream for one whole-model bench workload.
+
+    Writes ``<BENCH_TELEMETRY_DIR or ./telemetry>/<name>.jsonl`` so a
+    bench run leaves a stream ``python -m apex_tpu.telemetry summarize``
+    (and its ``--diff`` A/B mode, for comparing two bench runs) can
+    render, and surfaces ``<name>_goodput`` / ``<name>_step_ms_p95``
+    keys for the BENCH record.
+
+    The bench's timed loops only sync per *trial* (per-step syncs would
+    change the measurement), so step events carry the amortized
+    per-step time tagged ``timing="amortized"``.  Compile/warmup time
+    is booked to the ``compile`` bucket — which is why a bench stream's
+    goodput is meaningfully below 1 even on a clean run.
+
+    Telemetry must never cost the record: construction failures degrade
+    to a dead object whose methods no-op and whose ``finish`` returns
+    an error marker instead of raising.
+    """
+
+    def __init__(self, name):
+        self.name = name
+        self.step = 0
+        self._dead = None
+        try:
+            from apex_tpu import telemetry as tel
+
+            tel_dir = os.environ.get("BENCH_TELEMETRY_DIR") or os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "telemetry")
+            self.path = os.path.join(tel_dir, f"{name}.jsonl")
+            try:  # one stream per workload per bench run
+                os.remove(self.path)
+            except OSError:
+                pass
+            self._tel = tel
+            self.mem = tel.MemorySink()
+            self.bus = tel.TelemetryBus(
+                run_id=f"{name}-{os.getpid()}",
+                sinks=[tel.JsonlSink(self.path), self.mem])
+            self.acct = self.bus.accountant()
+            self.bus.emit("run_start", step=0, workload=name,
+                          fast=FAST)
+        except Exception as e:  # pragma: no cover — defensive only
+            self._dead = repr(e)[:120]
+
+    def compile_pause(self, seconds):
+        """Book warmup/jit-compile wall (emitted as a `recompile`
+        event: the mid-run step-time cliff this stream exists to
+        catch)."""
+        if self._dead:
+            return
+        try:
+            self.acct.pause(seconds, "compile")
+            self.bus.emit("recompile", step=self.step,
+                          duration_ms=round(seconds * 1e3, 3),
+                          source="bench_warmup")
+        except Exception as e:
+            self._dead = repr(e)[:120]
+
+    def trial(self, n_steps, total_s, scalars=None):
+        """Book one timed trial of ``n_steps`` steps that synced once at
+        the end; emits amortized per-step events."""
+        if self._dead:
+            return
+        try:
+            per = total_s / max(1, n_steps)
+            for i in range(n_steps):
+                self.step += 1
+                self.acct.step_done(
+                    self.step, step_s=per, timing="amortized",
+                    scalars=scalars if i == n_steps - 1 else None)
+        except Exception as e:
+            self._dead = repr(e)[:120]
+
+    def finish(self):
+        """Close the stream; returns the ``<name>_*`` BENCH keys."""
+        prefix = self.name
+        if self._dead:
+            return {f"{prefix}_telemetry_error": self._dead}
+        try:
+            self.acct.finish(step=self.step)
+            self.bus.close()
+            s = self._tel.summarize_events(self.mem.events)
+            return {
+                f"{prefix}_goodput": s.get("goodput"),
+                f"{prefix}_step_ms_p95": s.get("step_ms_p95"),
+                f"{prefix}_telemetry_file": os.path.basename(self.path),
+            }
+        except Exception as e:
+            return {f"{prefix}_telemetry_error": repr(e)[:120]}
+
+
+# ---------------------------------------------------------------------------
 # Workloads
 # ---------------------------------------------------------------------------
 
@@ -267,19 +364,24 @@ def _resnet_setup():
 
 def bench_resnet():
     """Returns (images/sec, analytic TFLOPS, cost-analysis TFLOPS, loss,
-    scaler-skipped step count).  The last is ``LossScaleState.skipped``
-    read off the final scale state — overflow-skipped steps surface in
-    the summary line instead of hiding in the state pytree (a bench
-    that silently skipped most of its steps would otherwise report a
-    great-looking loss)."""
+    scaler-skipped step count, telemetry keys).  The skip count is
+    ``LossScaleState.skipped`` read off the final scale state —
+    overflow-skipped steps surface in the summary line instead of
+    hiding in the state pytree (a bench that silently skipped most of
+    its steps would otherwise report a great-looking loss).  The
+    telemetry keys (``resnet50_goodput`` / ``resnet50_step_ms_p95``)
+    come from the workload's JSONL stream (:class:`_BenchTelemetry`)."""
     (train_step, params, bn_state, opt_state, scale_state,
      x, y) = _resnet_setup()
+    bt = _BenchTelemetry("resnet50")
 
     # warm the jit fastpath first, then read flops from an explicit
     # lower+compile (the persistent compile cache dedupes it)
+    t0 = time.perf_counter()
     params, bn_state, opt_state, scale_state, loss = train_step(
         params, bn_state, opt_state, scale_state, x, y)
     float(loss)
+    bt.compile_pause(time.perf_counter() - t0)
     cost_flops = profiling.cost_report_from_compiled(
         train_step.lower(params, bn_state, opt_state, scale_state,
                          x, y).compile()).flops
@@ -292,14 +394,20 @@ def bench_resnet():
             params, bn_state, opt_state, scale_state, loss = train_step(
                 params, bn_state, opt_state, scale_state, x, y)
         final_loss = float(loss)  # sync
-        best_dt = min(best_dt, (time.perf_counter() - t0) / STEPS)
+        trial_s = time.perf_counter() - t0
+        best_dt = min(best_dt, trial_s / STEPS)
+        bt.trial(STEPS, trial_s,
+                 scalars={"loss": final_loss,
+                          "loss_scale": scale_state.loss_scale,
+                          "scaler_skipped": scale_state.skipped})
     assert jnp.isfinite(final_loss), f"training diverged: {final_loss}"
     skipped = getattr(scale_state, "skipped", None)
     skipped = int(jax.device_get(skipped)) if skipped is not None else 0
     ips = BATCH / best_dt
     analytic_tflops = ips * RN50_ANALYTIC_FLOPS_PER_IMG / 1e12
     cost_tflops = cost_flops / best_dt / 1e12
-    return ips, analytic_tflops, cost_tflops, final_loss, skipped
+    return (ips, analytic_tflops, cost_tflops, final_loss, skipped,
+            bt.finish())
 
 
 GPT_L, GPT_H, GPT_V, GPT_SEQ = 24, 1024, 51200, 1024
@@ -544,10 +652,15 @@ def bench_gpt1p3b(roof):
     from apex_tpu.resilience import StepGuard
 
     guard = StepGuard(max_consecutive_skips=8)
+    bt = _BenchTelemetry("gpt1p3b")
+    if bt._dead is None:
+        guard.telemetry = bt.bus  # skip events ride the bench stream
 
     params, opt_state = fs.params, fs.opt_state
+    t0 = time.perf_counter()
     params, opt_state, loss = fs.step(params, opt_state, tokens, labels)
     first_loss = float(loss)  # post-step-1 loss on the fixed batch
+    bt.compile_pause(time.perf_counter() - t0)
     guard.update(bool(jnp.isfinite(first_loss)))
 
     steps = 4
@@ -559,7 +672,9 @@ def bench_gpt1p3b(roof):
                                               labels)
         final_loss = float(loss)  # sync
         guard.update(bool(jnp.isfinite(final_loss)))
-        best_dt = min(best_dt, (time.perf_counter() - t0) / steps)
+        trial_s = time.perf_counter() - t0
+        best_dt = min(best_dt, trial_s / steps)
+        bt.trial(steps, trial_s, scalars={"loss": final_loss})
     assert jnp.isfinite(final_loss), f"gpt1p3b diverged: {final_loss}"
 
     out = {
@@ -577,6 +692,10 @@ def bench_gpt1p3b(roof):
         # the loop's sync points, visible without reading the pytree
         "gpt1p3b_steps_skipped": guard.total_skipped,
     }
+    # telemetry stream keys (ISSUE 4): goodput + p95 step time from the
+    # workload's JSONL (`python -m apex_tpu.telemetry summarize` renders
+    # the same stream offline)
+    out.update(bt.finish())
 
     # device-clock step time (the relay's host dispatch gap distorts
     # wall; BASELINE.md r5 wall-vs-device note) — same closure pattern
@@ -1280,7 +1399,8 @@ def main():
         extras["hbm_roof_gb_s"] = round(hbm, 1)
 
     note("resnet50...")
-    ips, rn_tflops, rn_cost_tflops, rn_loss, rn_skipped = bench_resnet()
+    (ips, rn_tflops, rn_cost_tflops, rn_loss, rn_skipped,
+     rn_telemetry) = bench_resnet()
     extras["resnet50_analytic_tflops"] = round(rn_tflops, 1)
     extras["resnet50_cost_analysis_tflops"] = round(rn_cost_tflops, 1)
     extras["resnet50_final_loss"] = round(rn_loss, 3)
@@ -1288,6 +1408,9 @@ def main():
     # skipped counter — a bench whose loss came from mostly-skipped
     # steps must say so in the summary line
     extras["resnet50_scaler_skipped"] = rn_skipped
+    # telemetry stream keys (ISSUE 4): goodput + p95 from the workload's
+    # JSONL stream (telemetry/resnet50.jsonl; summarize/diff offline)
+    extras.update(rn_telemetry)
     if roof is not None:
         extras["resnet50_mfu_vs_roof"] = round(rn_tflops / roof, 3)
 

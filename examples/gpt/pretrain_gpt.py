@@ -66,6 +66,13 @@ def _extra_args(parser):
                         "may run before the collective watchdog logs a "
                         "straggler diagnostic and escalates to the "
                         "grace-period save-and-exit path; 0 disables")
+    g.add_argument("--telemetry-dir", default=None,
+                   help="write a structured telemetry JSONL stream "
+                        "(step events with loss/throughput, ckpt_save, "
+                        "watchdog, recompile) under this directory, plus "
+                        "a postmortem_*.jsonl flight-recorder dump on "
+                        "preemption/escalation; summarize offline with "
+                        "`python -m apex_tpu.telemetry summarize`")
     return parser
 
 
@@ -74,6 +81,11 @@ def build_config(args) -> GPTConfig:
     # _vocab_size_with_padding, arguments.py make-vocab-size-divisible-by)
     mult = args.make_vocab_size_divisible_by * args.tensor_model_parallel_size
     args.padded_vocab_size = ((args.vocab_size + mult - 1) // mult) * mult
+    # the Megatron argument clone leaves --max-position-embeddings None
+    # unless given; a position table shorter than seq_length is asserted
+    # against in arguments.py, so seq_length is the only sane default
+    if args.max_position_embeddings is None:
+        args.max_position_embeddings = args.seq_length
     return GPTConfig(
         num_layers=args.num_layers,
         hidden_size=args.hidden_size,
@@ -196,59 +208,151 @@ def main(argv=None):
     batches = token_batches(args, jax.random.PRNGKey(args.seed + 1))
     for _ in range(step0):
         next(batches)  # a resumed run must not re-see consumed batches
+
+    # telemetry (ISSUE 4): structured stream + crash flight recorder;
+    # step events carry the data-wait/step wall split, the loss rides
+    # the windowed batched fetch, and XLA recompiles are surfaced by
+    # the jax monitoring listener
+    bus = acct = None
+    compile_acc = {"s": 0.0}  # XLA compile wall since the last step
+    uninstall_recompile = lambda: None  # noqa: E731
+    if args.telemetry_dir:
+        from apex_tpu import telemetry as tele
+
+        bus = tele.TelemetryBus(
+            run_id=f"pretrain-gpt-{os.getpid()}",
+            sinks=[tele.JsonlSink(os.path.join(args.telemetry_dir,
+                                               "pretrain_gpt.jsonl"))],
+            mesh={"n_devices": tp * dp, "tp": tp, "dp": dp,
+                  "platform": jax.devices()[0].platform})
+        uninstall_recompile = tele.install_recompile_listener(
+            bus, on_duration=lambda s: compile_acc.__setitem__(
+                "s", compile_acc["s"] + s))
+        acct = bus.accountant(window=args.log_interval)
+        bus.emit("run_start", step=step0, workload="pretrain_gpt",
+                 config={"num_layers": args.num_layers,
+                         "hidden_size": args.hidden_size,
+                         "seq_length": args.seq_length,
+                         "global_batch_size": args.global_batch_size,
+                         "train_iters": args.train_iters})
+
     t0 = time.perf_counter()
     loss = None
     preempted = False
-    with resilience.GracePeriodHandler() as preempt:
-        # the watchdog arms a deadline around each collective-bearing
-        # step; a hang/straggler logs per-device heartbeats + duration
-        # percentiles and lands in the same grace-period exit as SIGTERM
-        watchdog = (resilience.Watchdog(args.watchdog_timeout,
-                                        handler=preempt)
-                    if args.watchdog_timeout > 0 else None)
-        for it in range(step0, args.train_iters):
-            tokens, labels = next(batches)
-            rng = jax.random.fold_in(jax.random.PRNGKey(args.seed + 2), it)
-            if watchdog is not None:
-                with watchdog.step(it):
+
+    def _save(step, blocking):
+        t_save = time.perf_counter()
+        ckpt.save_checkpoint(args.save, (params, opt_state), step=step,
+                             blocking=blocking)
+        if bus is not None:
+            dt_save = time.perf_counter() - t_save
+            acct.pause(dt_save, "ckpt_fence")
+            bus.emit("ckpt_save", step=step, blocking=blocking,
+                     wall_ms=round(dt_save * 1e3, 3))
+
+    try:
+        with resilience.GracePeriodHandler() as preempt:
+            # the watchdog arms a deadline around each collective-bearing
+            # step; a hang/straggler logs per-device heartbeats + duration
+            # percentiles and lands in the same grace-period exit as
+            # SIGTERM
+            watchdog = (resilience.Watchdog(args.watchdog_timeout,
+                                            handler=preempt)
+                        if args.watchdog_timeout > 0 else None)
+            if bus is not None and watchdog is not None:
+                bus.attach_watchdog(watchdog)
+
+            for it in range(step0, args.train_iters):
+                t_data = time.perf_counter()
+                tokens, labels = next(batches)
+                rng = jax.random.fold_in(
+                    jax.random.PRNGKey(args.seed + 2), it)
+                t_step = time.perf_counter()
+                if watchdog is not None:
+                    with watchdog.step(it):
+                        params, opt_state, loss = train_step(
+                            params, opt_state, tokens, labels, rng)
+                        loss.block_until_ready()
+                else:
                     params, opt_state, loss = train_step(
                         params, opt_state, tokens, labels, rng)
-                    loss.block_until_ready()
-            else:
-                params, opt_state, loss = train_step(params, opt_state,
-                                                     tokens, labels, rng)
-            if (it + 1) % args.log_interval == 0:
-                dt = (time.perf_counter() - t0) / args.log_interval
-                tok_s = args.global_batch_size * args.seq_length / dt
-                print(f"iter {it + 1}/{args.train_iters} "
-                      f"loss {float(loss):.4f} {dt * 1e3:.0f} ms/iter "
-                      f"{tok_s:,.0f} tok/s", flush=True)
-                t0 = time.perf_counter()
-            if preempt.should_stop:
-                # grace period: make the finished step durable, exit clean
-                preempted = True
-                if args.save:
-                    ckpt.save_checkpoint(args.save, (params, opt_state),
-                                         step=it + 1)
-                outcome = ("checkpoint written" if args.save
-                           else "no --save dir, progress lost")
-                print(f"preempted ({preempt.reason}) at iter {it + 1}: "
-                      f"{outcome}, exiting", flush=True)
-                break
-            if args.save and args.save_interval and \
-                    (it + 1) % args.save_interval == 0:
-                # async: the write overlaps the next training steps and the
-                # next save (or exit) fences on it
-                ckpt.save_checkpoint(args.save, (params, opt_state),
-                                     step=it + 1, blocking=False)
-        if watchdog is not None:
-            watchdog.close()
+                if acct is not None:
+                    if watchdog is None:
+                        # telemetry-grade step timing needs the step's
+                        # device wall, not the host dispatch gap; the
+                        # watchdog branch already synced.  The next step
+                        # consumes these buffers anyway, so this costs
+                        # only the host-side dispatch overlap.
+                        loss.block_until_ready()
+                    now = time.perf_counter()
+                    # compile wall inside this step goes to the compile
+                    # bucket, not productive goodput; the SCALAR costs
+                    # no extra sync — `loss` is a reference the
+                    # accountant fetches once per log window
+                    compile_s, compile_acc["s"] = compile_acc["s"], 0.0
+                    acct.step_done(it + 1, step_s=now - t_step,
+                                   data_wait_s=t_step - t_data,
+                                   scalars={"loss": loss},
+                                   compile_s=compile_s,
+                                   timing="synced")
+                if (it + 1) % args.log_interval == 0:
+                    dt = (time.perf_counter() - t0) / args.log_interval
+                    tok_s = args.global_batch_size * args.seq_length / dt
+                    print(f"iter {it + 1}/{args.train_iters} "
+                          f"loss {float(loss):.4f} {dt * 1e3:.0f} ms/iter "
+                          f"{tok_s:,.0f} tok/s", flush=True)
+                    t0 = time.perf_counter()
+                if preempt.should_stop:
+                    # grace period: make the finished step durable, exit
+                    # clean
+                    preempted = True
+                    if args.save:
+                        _save(it + 1, blocking=True)
+                    outcome = ("checkpoint written" if args.save
+                               else "no --save dir, progress lost")
+                    print(f"preempted ({preempt.reason}) at iter {it + 1}: "
+                          f"{outcome}, exiting", flush=True)
+                    if bus is not None:
+                        # machine-readable last-N-steps record next to
+                        # the stream — the crash-postmortem half
+                        bus.flush_postmortem(preempt.reason or "preempted",
+                                             step=it + 1, watchdog=watchdog)
+                    break
+                if args.save and args.save_interval and \
+                        (it + 1) % args.save_interval == 0:
+                    # async: the write overlaps the next training steps
+                    # and the next save (or exit) fences on it
+                    _save(it + 1, blocking=False)
+            if watchdog is not None:
+                watchdog.close()
+    except BaseException as e:
+        # hard crash (XLA error, ^C): the postmortem is the record of
+        # how the run died — flush it before unwinding, never letting
+        # telemetry mask the primary failure
+        if bus is not None:
+            try:
+                bus.flush_postmortem(type(e).__name__)
+                acct.finish(reason=type(e).__name__)
+                bus.close()
+            except Exception:
+                pass
+        raise
+    finally:
+        if bus is not None:
+            uninstall_recompile()
     if args.save and not preempted and not (
             args.save_interval
             and args.train_iters % args.save_interval == 0):
-        ckpt.save_checkpoint(args.save, (params, opt_state),
-                             step=args.train_iters)
+        # the final checkpoint rides the same instrumented path, so its
+        # (blocking) write shows up in ckpt_fence/ckpt_save like every
+        # other save
+        _save(args.train_iters, blocking=True)
     resilience.wait_for_save()
+    if bus is not None:
+        acct.finish(step=args.train_iters if not preempted else None,
+                    reason=(preempt.reason or "preempted") if preempted
+                    else "completed")
+        bus.close()
     if preempted:
         parallel_state.destroy_model_parallel()
         return float(loss) if loss is not None else None
