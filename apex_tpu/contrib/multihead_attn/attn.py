@@ -100,25 +100,46 @@ class SelfMultiheadAttn:
         kh = _split_heads(k, self.num_heads)
         vh = _split_heads(v, self.num_heads)
 
-        mask_bias = None
-        if key_padding_mask is not None:
-            # [b, sk] -> additive [b*heads, sq, sk]
-            if self.mask_additive:
-                add = key_padding_mask.astype(jnp.float32)
-            else:
-                add = jnp.where(key_padding_mask, -10000.0, 0.0)
-            add = jnp.repeat(add[:, None, None, :], self.num_heads, axis=1)
-            mask_bias = jnp.broadcast_to(
-                add, (b, self.num_heads, s, add.shape[-1])).reshape(
-                b * self.num_heads, s, add.shape[-1])
-        if attn_mask is not None:
-            am = (attn_mask.astype(jnp.float32) if self.mask_additive
-                  else jnp.where(attn_mask, -10000.0, 0.0))
-            am = jnp.broadcast_to(am, (b * self.num_heads, s, s))
-            mask_bias = am if mask_bias is None else mask_bias + am
-
-        ctx = flash_attention(qh, kh, vh, mask_bias=mask_bias,
-                              scale=self.scaling)
+        if (key_padding_mask is not None and attn_mask is None
+                and not self.mask_additive):
+            # boolean key-padding variant (r7): ride the varlen fast
+            # path — segment ids with all-ones query ids reproduce
+            # key-side-only masking (pad query rows still attend real
+            # keys, like the -10000.0 additive fill whose exp
+            # underflows to the same zeros), without materialising a
+            # [b*heads, sq, sk] additive mask, and with padding-tail
+            # k-blocks skipped in-kernel via the block-skip index.
+            # Exact for every row with >= 1 real key; a row whose mask
+            # is ALL True returns zeros (the flash l==0 convention)
+            # where the additive fill would return a softmax over the
+            # masked keys — garbage either way, but different garbage
+            keep = (~key_padding_mask.astype(bool)).astype(jnp.int32)
+            seg_k = jnp.repeat(keep, self.num_heads, axis=0)  # [b*h, sk]
+            ctx = flash_attention(
+                qh, kh, vh,
+                segment_ids=(jnp.ones((qh.shape[0], s), jnp.int32),
+                             seg_k),
+                scale=self.scaling)
+        else:
+            mask_bias = None
+            if key_padding_mask is not None:
+                # [b, sk] -> additive [b*heads, sq, sk]
+                if self.mask_additive:
+                    add = key_padding_mask.astype(jnp.float32)
+                else:
+                    add = jnp.where(key_padding_mask, -10000.0, 0.0)
+                add = jnp.repeat(add[:, None, None, :], self.num_heads,
+                                 axis=1)
+                mask_bias = jnp.broadcast_to(
+                    add, (b, self.num_heads, s, add.shape[-1])).reshape(
+                    b * self.num_heads, s, add.shape[-1])
+            if attn_mask is not None:
+                am = (attn_mask.astype(jnp.float32) if self.mask_additive
+                      else jnp.where(attn_mask, -10000.0, 0.0))
+                am = jnp.broadcast_to(am, (b * self.num_heads, s, s))
+                mask_bias = am if mask_bias is None else mask_bias + am
+            ctx = flash_attention(qh, kh, vh, mask_bias=mask_bias,
+                                  scale=self.scaling)
         if is_training and self.dropout > 0.0 and dropout_rng is not None:
             # the reference fuses dropout into the softmax kernel; applying
             # it on the context preserves the regularisation contract
@@ -182,17 +203,29 @@ class EncdecMultiheadAttn(SelfMultiheadAttn):
         vh = _split_heads(v_, self.num_heads)
 
         sk = enc.shape[0]
-        mask_bias = None
-        if key_padding_mask is not None:
-            add = (key_padding_mask.astype(jnp.float32) if self.mask_additive
-                   else jnp.where(key_padding_mask, -10000.0, 0.0))
-            add = jnp.repeat(add[:, None, None, :], self.num_heads, axis=1)
-            mask_bias = jnp.broadcast_to(
-                add, (b, self.num_heads, sq, sk)).reshape(
-                b * self.num_heads, sq, sk)
-
-        ctx = flash_attention(qh, kh, vh, mask_bias=mask_bias,
-                              scale=self.scaling)
+        if (key_padding_mask is not None and attn_mask is None
+                and not self.mask_additive):
+            # encoder-side padding as segment ids (cross-length pair):
+            # same varlen fast-path routing as the self variant
+            keep = (~key_padding_mask.astype(bool)).astype(jnp.int32)
+            ctx = flash_attention(
+                qh, kh, vh,
+                segment_ids=(jnp.ones((qh.shape[0], sq), jnp.int32),
+                             jnp.repeat(keep, self.num_heads, axis=0)),
+                scale=self.scaling)
+        else:
+            mask_bias = None
+            if key_padding_mask is not None:
+                add = (key_padding_mask.astype(jnp.float32)
+                       if self.mask_additive
+                       else jnp.where(key_padding_mask, -10000.0, 0.0))
+                add = jnp.repeat(add[:, None, None, :], self.num_heads,
+                                 axis=1)
+                mask_bias = jnp.broadcast_to(
+                    add, (b, self.num_heads, sq, sk)).reshape(
+                    b * self.num_heads, sq, sk)
+            ctx = flash_attention(qh, kh, vh, mask_bias=mask_bias,
+                                  scale=self.scaling)
         if is_training and self.dropout > 0.0 and dropout_rng is not None:
             keep = jax.random.bernoulli(dropout_rng, 1 - self.dropout,
                                         ctx.shape)

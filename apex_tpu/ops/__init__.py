@@ -10,7 +10,11 @@ reference contract it mirrors.
 from apex_tpu.ops.attention import (  # noqa: F401
     flash_attention,
     flash_attention_qkv,
+    flash_attention_qkv_route,
+    flash_attention_route,
+    flash_attention_varlen,
     ring_attention,
+    routing_override,
 )
 from apex_tpu.ops.fused_dense import (  # noqa: F401
     FusedDense,

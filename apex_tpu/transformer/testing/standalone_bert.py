@@ -106,10 +106,17 @@ class BertModel:
                                                          rank)
         return p
 
-    def embed(self, params, tokens, tokentype_ids=None):
+    def embed(self, params, tokens, tokentype_ids=None, position_ids=None):
         h = self.embedding.apply(params["embedding"], tokens)
-        pos = params["position_embeddings"]["weight"][:tokens.shape[1]]
-        h = h + pos[None]
+        if position_ids is None:
+            pos = params["position_embeddings"]["weight"][:tokens.shape[1]]
+            h = h + pos[None]
+        else:
+            # explicit per-token positions (varlen packing: positions
+            # restart at each segment boundary, the reference packing
+            # convention — each packed sequence sees the same position
+            # embeddings it would see padded)
+            h = h + params["position_embeddings"]["weight"][position_ids]
         if tokentype_ids is not None and "tokentype_embeddings" in params:
             h = h + params["tokentype_embeddings"]["weight"][tokentype_ids]
         return h.astype(self.cfg.compute_dtype)
@@ -128,20 +135,31 @@ class BertModel:
         return logits + lm["bias"]
 
     def apply(self, params, tokens, attention_mask=None, tokentype_ids=None,
-              lm_labels=None, dropout_key=None):
+              lm_labels=None, dropout_key=None, segment_ids=None,
+              position_ids=None):
         """Returns ``(lm_losses_or_logits, binary_logits)``.
 
         ``dropout_key`` enables the config's attention/hidden dropout
         (training mode), with the same TP-replicated/per-rank stream
-        discipline as the GPT (see standalone_gpt.GPTModel.apply)."""
-        h = self.embed(params, tokens, tokentype_ids)
+        discipline as the GPT (see standalone_gpt.GPTModel.apply).
+
+        ``segment_ids`` (r7): int [b, s] varlen-*packing* ids — several
+        real sequences share one row of ``tokens``, delimited by id
+        changes (the reference FMHA's cu_seqlens packing, fmha.py:33-75;
+        give trailing pad tokens their own id bucket).  Attention is
+        masked across segments; with ``use_flash_attention`` the packed
+        rows ride the transpose-free varlen fast path with block-skip.
+        Pass ``position_ids`` restarting at each segment so every packed
+        sequence sees the same position embeddings it would see padded."""
+        h = self.embed(params, tokens, tokentype_ids, position_ids)
         h = embedding_dropout(h, self.cfg, dropout_key)
         # padding mask [b, 1, 1, s] -> broadcast [b, 1, s, s], True = masked
         am = None
         if attention_mask is not None:
             am = ~attention_mask[:, None, None, :].astype(bool)
         h, _aux = self.transformer.apply(params["transformer"], h, am,
-                                         dropout_key=dropout_key)
+                                         dropout_key=dropout_key,
+                                         segment_ids=segment_ids)
 
         binary_logits = None
         if self.cfg.add_binary_head and "binary_head" in params:

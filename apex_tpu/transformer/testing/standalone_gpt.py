@@ -150,8 +150,10 @@ class ParallelAttention:
         return {"qkv": self.qkv.shard_master(master["qkv"], rank),
                 "proj": self.proj.shard_master(master["proj"], rank)}
 
-    def apply(self, params, h, attention_mask=None, dropout_key=None):
-        # h: [b, s, hidden]
+    def apply(self, params, h, attention_mask=None, dropout_key=None,
+              segment_ids=None):
+        # h: [b, s, hidden]; segment_ids: int [b, s] varlen-packing ids
+        # (pad tokens in their own bucket) — masks cross-segment scores
         cfg = self.cfg
         do_dropout = dropout_key is not None and cfg.attention_dropout > 0.0
         b, s, _ = h.shape
@@ -169,46 +171,45 @@ class ParallelAttention:
         # the module's mask type, not the mask's presence, decides
         # causality (GPT: causal even WITH an extra padding mask)
         is_causal = self.softmax.attn_mask_type == AttnMaskType.causal
-        if cfg.use_flash_attention and attention_mask is None:
+        is_key_padding = (attention_mask is not None
+                          and attention_mask.ndim == 4
+                          and attention_mask.shape[1] == 1
+                          and attention_mask.shape[2] == 1)
+        if cfg.use_flash_attention and (
+                attention_mask is None or is_key_padding):
             # Packed flash kernel: consumes the QKV projection output
             # directly in its interleaved per-head layout and emits
             # dqkv the same way — no head transposes in forward,
             # recompute, or backward (r5; ~10 ms/step of layout copies
-            # at the 350M bench shape)
+            # at the 350M bench shape).  Varlen shapes STAY on it (r7):
+            # explicit packing ids, and KEY-PADDING masks ([b, 1, 1, s],
+            # True = masked key — the BERT form) as segment ids with
+            # all-ones query ids, reproducing key-side-only masking
+            # exactly (pad QUERY rows still attend real keys, like the
+            # reference's additive mask; the reference FMHA existed for
+            # precisely this BERT varlen case, fmha.py:33-75).  The
+            # segment predicate is fused in-kernel and fully-masked
+            # k-blocks are skipped via the block-skip index; composes
+            # with the causal flag for causal-model + padding callers.
             from apex_tpu.ops.attention import flash_attention_qkv
 
+            seg = None
+            if segment_ids is not None:
+                seg = segment_ids
+                if is_key_padding:
+                    # fold padding into the packing ids: pad keys get a
+                    # bucket no real segment uses (ids are >= 0), so no
+                    # query row — any packing id — attends a pad key
+                    pad = attention_mask[:, 0, 0, :].astype(bool)
+                    seg = (seg, jnp.where(pad, -1, seg))
+            elif is_key_padding:
+                keep = (~attention_mask[:, 0, 0, :].astype(bool)).astype(
+                    jnp.int32)  # [b, s], 1 = real token
+                seg = (jnp.ones_like(keep), keep)
             ctx = flash_attention_qkv(
-                qkv, self.np_local, causal=is_causal,
+                qkv, self.np_local, causal=is_causal, segment_ids=seg,
                 block=cfg.flash_block_q, block_k=cfg.flash_block_k,
                 **flash_drop).astype(h.dtype)
-            return self.proj.apply(params["proj"], ctx)
-        if (cfg.use_flash_attention and attention_mask is not None
-                and attention_mask.ndim == 4
-                and attention_mask.shape[1] == 1
-                and attention_mask.shape[2] == 1):
-            # KEY-PADDING mask ([b, 1, 1, s], True = masked key — the
-            # BERT form): flash handles it as segment ids with all-ones
-            # query ids, reproducing key-side-only masking exactly (pad
-            # QUERY rows still attend real keys, like the reference's
-            # additive mask; the reference FMHA existed for precisely
-            # this BERT varlen case, fmha.py:33-75).  Composes with the
-            # causal flag for causal-model + padding-mask callers.
-            from apex_tpu.ops.attention import flash_attention
-
-            np_l, hn = self.np_local, cfg.kv_channels
-            q4, k4, v4 = (
-                t.transpose(0, 2, 1, 3)  # [b, np, s, hn]
-                for t in jnp.split(
-                    qkv.reshape(b, s, np_l, 3 * hn), 3, axis=-1))
-            keep = (~attention_mask[:, 0, 0, :].astype(bool)).astype(
-                jnp.int32)  # [b, s], 1 = real token
-            ctx = flash_attention(
-                q4, k4, v4, causal=is_causal,
-                segment_ids=(jnp.ones_like(keep), keep),
-                block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
-                **flash_drop)
-            ctx = ctx.transpose(0, 2, 1, 3).reshape(
-                b, s, np_l * hn).astype(h.dtype)
             return self.proj.apply(params["proj"], ctx)
         qkv = qkv.reshape(b, s, self.np_local, 3 * cfg.kv_channels)
         q, k, v = jnp.split(qkv, 3, axis=-1)  # each [b, s, np, hn]
@@ -217,6 +218,16 @@ class ParallelAttention:
         scores = jnp.einsum("bqnh,bknh->bnqk", q, k,
                             preferred_element_type=jnp.float32)
         scores = (scores * scale).astype(h.dtype)
+        if segment_ids is not None:
+            # reference path for the packed form: cross-segment scores
+            # masked through the same boolean-mask softmax (True =
+            # masked) the padding variant uses — the parity anchor for
+            # the flash packed path
+            seg_mask = (segment_ids[:, None, :, None]
+                        != segment_ids[:, None, None, :])
+            if attention_mask is not None:
+                seg_mask = seg_mask | attention_mask.astype(bool)
+            attention_mask = seg_mask
         probs = self.softmax(scores, attention_mask)
         if do_dropout:
             # probs are head-sharded over TP: per-rank stream (reference
@@ -334,7 +345,8 @@ class ParallelTransformerLayer:
             "mlp": mlp,
         }
 
-    def apply(self, params, h, attention_mask=None, dropout_key=None):
+    def apply(self, params, h, attention_mask=None, dropout_key=None,
+              segment_ids=None):
         """Returns ``(h, aux)`` — ``aux`` is the MoE load-balancing loss
         (0.0 for the dense MLP)."""
         cfg = self.cfg
@@ -346,7 +358,8 @@ class ParallelTransformerLayer:
         ln1 = layer_norm(h, params["input_layernorm"]["weight"],
                          params["input_layernorm"]["bias"], eps=eps)
         attn = self.attention.apply(params["attention"], ln1, attention_mask,
-                                    dropout_key=k_attn)
+                                    dropout_key=k_attn,
+                                    segment_ids=segment_ids)
         # named for remat_policy="attn_out": saving just this [b,s,h]
         # tensor per layer (16 MB at the 350M bench shape) removes the
         # whole attention region from the remat recompute
@@ -392,7 +405,8 @@ class ParallelTransformer:
 
         return {"layers": shard(master["layers"])}
 
-    def apply(self, params, h, attention_mask=None, dropout_key=None):
+    def apply(self, params, h, attention_mask=None, dropout_key=None,
+              segment_ids=None):
         """Returns ``(h, aux)``; ``aux`` sums the layers' MoE
         load-balancing losses (0.0 for dense MLPs)."""
         def body(carry, xs):
@@ -401,7 +415,8 @@ class ParallelTransformer:
             k = (None if dropout_key is None
                  else jax.random.fold_in(dropout_key, idx))
             hidden, aux = self.layer.apply(layer_params, hidden,
-                                           attention_mask, dropout_key=k)
+                                           attention_mask, dropout_key=k,
+                                           segment_ids=segment_ids)
             return (hidden, aux_sum + aux), None
 
         if self.cfg.remat:

@@ -164,16 +164,16 @@ class TestFlashAttention:
         scale = 1.0 / np.sqrt(hn)
         qkv = jax.random.normal(jax.random.PRNGKey(0),
                                 (b, s, nh * 3 * hn), jnp.float32)
-        ctx, res = _flash_qkv_fwd_rule(qkv, 0, nh, hn, scale, True,
-                                       block, 0.0)
-        lse = res[3]
+        ctx, res = _flash_qkv_fwd_rule(qkv, None, None, 0, nh, hn, scale,
+                                       True, block, 0.0)
+        lse = res[5]
         n_hg, group, n_b = 1, 2, s // block
         assert lse.shape == (b, n_hg, group, n_b, 1, block), lse.shape
 
         dctx = jax.random.normal(jax.random.PRNGKey(1), (b, s, nh * hn),
                                  jnp.float32)
-        dqkv, _ = _flash_qkv_bwd_rule(nh, hn, scale, True, block, 0.0,
-                                      res, dctx)
+        dqkv, _, _, _ = _flash_qkv_bwd_rule(nh, hn, scale, True, block,
+                                            0.0, res, dctx)
 
         def loss_ref(qkv):
             q, k, v = _unpack_qkv(qkv, nh, hn)
@@ -368,6 +368,228 @@ class TestFlashAttention:
         g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-4)
+
+
+class TestVarlenFastPath:
+    """The r7 varlen fast path (ISSUE 5 tentpole): block-skip index,
+    varlen/stream_skip/grid_skip kernels, packed-QKV segment masking,
+    and the routing decisions that select them."""
+
+    def _tpu(self, monkeypatch):
+        from apex_tpu.ops import attention as attn_mod
+
+        monkeypatch.setattr(attn_mod.jax, "default_backend",
+                            lambda: "tpu")
+        return attn_mod
+
+    def test_routing_varlen_selects_fast_kernels(self, monkeypatch):
+        # L0 routing satellite: varlen/padding shapes now select the
+        # fast kernels; gates failing falls back correctly
+        attn_mod = self._tpu(monkeypatch)
+        sd = lambda s, d=64: jax.ShapeDtypeStruct((8, s, d), jnp.bfloat16)
+        r = attn_mod.flash_attention_route(sd(512), segment_ids=True,
+                                           block_q=128, block_k=128)
+        assert r == {"fwd": "varlen", "bwd": "grid_skip"}
+        # no segments: the r5 routes are unchanged
+        r = attn_mod.flash_attention_route(sd(512), block_q=128,
+                                           block_k=128)
+        assert r == {"fwd": "tiles", "bwd": "tiles"}
+        # a working set past the whole-sequence VMEM gate: the varlen
+        # forward falls back to the grid kernel WITH the skip index
+        r = attn_mod.flash_attention_route(sd(16384, 256),
+                                           segment_ids=True,
+                                           block_q=512, block_k=512)
+        assert r["fwd"] == "stream_skip"
+        # unalignable shape: everything falls to the XLA path
+        r = attn_mod.flash_attention_route(sd(1000), segment_ids=True,
+                                           block_q=128, block_k=128)
+        assert r == {"fwd": "xla", "bwd": "xla"}
+
+    def test_routing_qkv_packed_varlen(self, monkeypatch):
+        attn_mod = self._tpu(monkeypatch)
+        route = attn_mod.flash_attention_qkv_route
+        assert route(8, 512, 16, 64, has_segments=True) == "packed_varlen"
+        assert route(8, 512, 16, 64) == "packed"
+        # gate failure (unaligned seq) falls back to the generic path
+        assert route(8, 1000, 16, 64, has_segments=True) == "generic"
+
+    def test_routing_override_forces_generic(self, monkeypatch):
+        attn_mod = self._tpu(monkeypatch)
+        sd = jax.ShapeDtypeStruct((8, 512, 64), jnp.bfloat16)
+        with attn_mod.routing_override(fwd="stream", bwd="grid"):
+            r = attn_mod.flash_attention_route(sd, segment_ids=True,
+                                               block_q=128, block_k=128)
+        assert r == {"fwd": "stream", "bwd": "grid"}
+        # override does not leak
+        r = attn_mod.flash_attention_route(sd, segment_ids=True,
+                                           block_q=128, block_k=128)
+        assert r["fwd"] == "varlen"
+
+    def test_segment_block_bounds_conservative(self):
+        """The skip index may keep a dead tile but must NEVER skip a
+        live one — checked against brute-force equality on random ids,
+        plus tightness on the two shapes that matter (ascending packing,
+        descending key-padding)."""
+        from apex_tpu.ops.attention import _segment_block_bounds
+
+        rng = np.random.RandomState(0)
+        for _ in range(5):
+            seg_q = jnp.asarray(rng.randint(0, 4, (2, 64)), jnp.int32)
+            seg_k = jnp.asarray(rng.randint(0, 4, (2, 64)), jnp.int32)
+            lq, lk = _segment_block_bounds(seg_q, seg_k, 16, 8)
+            live = (np.asarray(seg_q)[:, :, None]
+                    == np.asarray(seg_k)[:, None, :])
+            for b in range(2):
+                for qb in range(4):
+                    rows = slice(qb * 16, qb * 16 + 16)
+                    for kb in range(8):
+                        cols = slice(kb * 8, kb * 8 + 8)
+                        if live[b, rows, cols].any():
+                            lo, hi = np.asarray(lq)[b, qb]
+                            assert lo <= kb < hi, (b, qb, kb, lo, hi)
+        # tightness on a padding tail: all-pad k-blocks are outside
+        seg_q = jnp.ones((1, 64), jnp.int32)
+        seg_k = jnp.asarray([[1] * 40 + [0] * 24], jnp.int32)
+        lq, _ = _segment_block_bounds(seg_q, seg_k, 16, 8)
+        assert np.asarray(lq)[0, 0].tolist() == [0, 5]  # 40/8 = 5 blocks
+
+    @pytest.mark.slow  # interpret-mode Pallas varlen kernels (ISSUE 5)
+    @pytest.mark.parametrize("route", ["varlen", "stream_skip"])
+    def test_varlen_fwd_kernels_interpret_match(self, route):
+        from apex_tpu.ops.attention import _flash_fwd_pallas
+
+        bh, s, d = 2, 64, 16
+        q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (bh, s, d))
+                   for i in range(3))
+        seg = jnp.asarray([[0] * 24 + [1] * 24 + [2] * 16,
+                           [0] * 40 + [1] * 8 + [2] * 16], jnp.int32)
+        scale = 1.0 / np.sqrt(d)
+        o, lse = _flash_fwd_pallas(q, k, v, None, seg, seg, 0, scale,
+                                   False, 16, 16, 0.0, route=route)
+        ref = _naive_seg(q, k, v, seg, scale)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+        assert lse.shape == (bh, s)
+
+    @pytest.mark.slow  # interpret-mode Pallas varlen kernels (ISSUE 5)
+    def test_varlen_grid_skip_bwd_interpret_matches(self):
+        from apex_tpu.ops.attention import (_flash_bwd_pallas,
+                                            _flash_fwd_pallas)
+
+        bh, s, d = 2, 64, 16
+        q, k, v, do = (jax.random.normal(jax.random.PRNGKey(i),
+                                         (bh, s, d)) for i in range(4))
+        seg = jnp.asarray([[0] * 24 + [1] * 40], jnp.int32)
+        scale = 1.0 / np.sqrt(d)
+        o, lse = _flash_fwd_pallas(q, k, v, None, seg, seg, 0, scale,
+                                   False, 16, 16, 0.0, route="varlen")
+        dq, dk, dv = _flash_bwd_pallas(q, k, v, None, seg, seg, 0, o,
+                                       lse, do, scale, False, 16, 16,
+                                       0.0, route="grid_skip")
+        gq, gk, gv = jax.grad(
+            lambda q, k, v: jnp.sum(_naive_seg(q, k, v, seg, scale) * do),
+            argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(dq, gq, rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(dk, gk, rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(dv, gv, rtol=1e-3, atol=1e-4)
+
+    @pytest.mark.slow  # interpret-mode packed varlen kernels (ISSUE 5)
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_packed_qkv_varlen_interpret_matches(self, causal):
+        """In-kernel segment masking on the packed-QKV kernels (the
+        tentpole's fast tile schedule) vs the generic reference —
+        interpret-mode parity, fwd and bwd, incl. the dynamic
+        block-skip carry loop."""
+        from apex_tpu.ops.attention import (_flash_qkv_bwd_pallas,
+                                            _flash_qkv_fwd_pallas)
+
+        b, s, nh, hn = 2, 64, 2, 64  # group=2 at hn=64
+        scale = 1.0 / np.sqrt(hn)
+        qkv = jax.random.normal(jax.random.PRNGKey(0),
+                                (b, s, nh * 3 * hn), jnp.float32)
+        seg = jnp.asarray([[0] * 24 + [1] * 40,
+                           [0] * 40 + [7] * 24], jnp.int32)
+
+        def ref(qkv):
+            q, k, v = _unpack_qkv(qkv, nh, hn)
+            s_ = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+            s_ = jnp.where(seg[:, None, :, None] == seg[:, None, None, :],
+                           s_, -1e30)
+            if causal:
+                tri = jnp.tril(jnp.ones((s, s), bool))
+                s_ = jnp.where(tri, s_, -1e30)
+            out = jax.nn.softmax(s_, -1) @ v
+            return out.transpose(0, 2, 1, 3).reshape(b, s, nh * hn)
+
+        ctx, lse = _flash_qkv_fwd_pallas(qkv, 0, nh, hn, scale, causal,
+                                         16, 0.0, seg_q=seg, seg_k=seg)
+        np.testing.assert_allclose(ctx, ref(qkv), rtol=1e-4, atol=1e-5)
+        dctx = jax.random.normal(jax.random.PRNGKey(1), ctx.shape)
+        dqkv = _flash_qkv_bwd_pallas(qkv, 0, ctx, lse, dctx, nh, hn,
+                                     scale, causal, 16, 0.0,
+                                     seg_q=seg, seg_k=seg)
+        dref = jax.grad(lambda x: jnp.sum(ref(x) * dctx))(qkv)
+        np.testing.assert_allclose(dqkv, dref, rtol=1e-3, atol=1e-4)
+
+    def test_qkv_wrapper_segments_fallback_matches(self):
+        """Public flash_attention_qkv(segment_ids=...) — off-TPU this
+        takes the generic fallback with identical math; grads flow."""
+        b, s, nh, hn = 2, 32, 2, 8
+        qkv = jax.random.normal(jax.random.PRNGKey(0), (b, s, nh * 3 * hn))
+        seg = jnp.asarray([[0] * 12 + [1] * 20, [0] * 20 + [1] * 12],
+                          jnp.int32)
+
+        def ref(qkv):
+            q, k, v = _unpack_qkv(qkv, nh, hn)
+            s_ = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(hn)
+            s_ = jnp.where(seg[:, None, :, None] == seg[:, None, None, :],
+                           s_, -1e30)
+            out = jax.nn.softmax(s_, -1) @ v
+            return out.transpose(0, 2, 1, 3).reshape(b, s, nh * hn)
+
+        ctx = flash_attention_qkv(qkv, nh, causal=False, block=16,
+                                  segment_ids=seg)
+        np.testing.assert_allclose(ctx, ref(qkv), rtol=1e-4, atol=1e-5)
+        g = jax.grad(lambda x: jnp.sum(flash_attention_qkv(
+            x, nh, causal=False, block=16, segment_ids=seg) ** 2))(qkv)
+        gr = jax.grad(lambda x: jnp.sum(ref(x) ** 2))(qkv)
+        np.testing.assert_allclose(g, gr, rtol=1e-3, atol=1e-4)
+
+    @pytest.mark.slow  # interpret-mode zero-trip edge (ISSUE 5)
+    def test_varlen_fully_masked_block_emits_zeros(self):
+        """A q-block whose segment has no matching keys anywhere gets a
+        zero-trip skip loop: zeros out, -inf lse, finite (zero) grads —
+        the l == 0 convention of every other kernel."""
+        from apex_tpu.ops.attention import (_flash_bwd_pallas,
+                                            _flash_fwd_pallas)
+
+        bh, s, d = 1, 48, 16
+        q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (bh, s, d))
+                   for i in range(3))
+        seg_q = jnp.asarray([[0] * 16 + [9] * 16 + [1] * 16], jnp.int32)
+        seg_k = jnp.asarray([[0] * 16 + [2] * 16 + [1] * 16], jnp.int32)
+        scale = 1.0 / np.sqrt(d)
+        o, lse = _flash_fwd_pallas(q, k, v, None, seg_q, seg_k, 0,
+                                   scale, False, 16, 16, 0.0,
+                                   route="varlen")
+        assert np.allclose(np.asarray(o)[0, 16:32], 0.0)
+        assert np.all(np.asarray(lse)[0, 16:32] < -1e29)
+        do = jnp.ones_like(q)
+        dq, dk, dv = _flash_bwd_pallas(q, k, v, None, seg_q, seg_k, 0,
+                                       o, lse, do, scale, False, 16, 16,
+                                       0.0, route="grid_skip")
+        for t in (dq, dk, dv):
+            assert np.isfinite(np.asarray(t)).all()
+        assert np.allclose(np.asarray(dq)[0, 16:32], 0.0)
+
+
+def _naive_seg(q, k, v, seg, scale):
+    s_ = jnp.einsum("bqd,bkd->bqk", q, k) * scale
+    s_ = jnp.where(seg[:, :, None] == seg[:, None, :], s_, -1e30)
+    p = jax.nn.softmax(s_, -1)
+    # rows with no visible key are zero under the flash l==0 convention
+    dead = (seg[:, :, None] == seg[:, None, :]).sum(-1) == 0
+    return jnp.where(dead[..., None], 0.0, p @ v)
 
 
 class TestVarlen:

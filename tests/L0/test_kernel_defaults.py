@@ -158,6 +158,84 @@ def test_gate_covers_every_speedup_field():
         f"{name}: speedup fields without a kernel_defaults gate: {ungated}")
 
 
+def test_sweep_cells_not_losing():
+    """Applicability-window sweeps (VERDICT r5 Weak #2, acted on in r7):
+    every per-shape cell recorded in the sweep sections must stay above
+    the parity floor — a losing cell means the fused formulation is
+    worse than naive somewhere inside the window it claims, which the
+    single-shape scalar gates cannot see.  Winners (>= SWEEP_WIN_MIN)
+    are surfaced by kernel_defaults.sweep_verdict as the per-shape
+    evidence behind keeping each default (the demote-or-gate decision
+    protocol recorded in BASELINE.md)."""
+    from apex_tpu.ops.kernel_defaults import (
+        SWEEP_PARITY_MIN, SWEEP_SECTIONS, sweep_cells, sweep_verdict)
+
+    name, extras = _latest_record()
+    if extras is None:
+        pytest.skip("no bench_schema>=2 record committed yet")
+    # the per-shape tables ride the sidecar (bench.py writes them there
+    # directly, not via the spill path) — the sidecar is rewritten each
+    # bench run, so it speaks for the newest record, which is exactly
+    # the one _latest_record selects for enforcement
+    try:
+        with open(os.path.join(REPO, "BENCH_TOPOPS.json")) as f:
+            sidecar = json.load(f)
+    except Exception:
+        sidecar = {}
+    failures, seen = [], 0
+    for entry in SWEEP_SECTIONS:
+        section = extras.get(entry, sidecar.get(entry))
+        if not isinstance(section, dict):
+            continue  # sweep not in this record: no verdict
+        seen += 1
+        verdict = sweep_verdict(section)
+        for cell, ratio in sweep_cells(section):
+            if ratio < SWEEP_PARITY_MIN:
+                failures.append(
+                    f"{name}: {entry}.{cell} ratio {ratio} < "
+                    f"{SWEEP_PARITY_MIN} — the fused form LOSES at this "
+                    f"shape; demote it for this cell (losers="
+                    f"{verdict['losers']})")
+    if not seen:
+        pytest.skip(f"{name} carries no sweep sections yet (first "
+                    "driver run after r6 records them)")
+    assert not failures, "\n".join(failures)
+
+
+def test_sweep_verdict_classifies():
+    """The demote-or-gate helper: winners/parity/losers split at the
+    documented thresholds, tolerating error cells and scalar tails."""
+    from apex_tpu.ops.kernel_defaults import sweep_verdict
+
+    section = {
+        "sk512_causal": {"ratio": 1.31},
+        "sk1024_causal": {"ratio": 1.0},
+        "sk2048_padding": {"ratio": 0.7},
+        "sk4096_causal": {"error": "boom"},
+        "s384": {"fast_vs_generic": 1.2},
+        "min_ratio": 0.7,
+    }
+    v = sweep_verdict(section)
+    assert v["winners"] == ["sk512_causal", "s384"]
+    assert v["parity"] == ["sk1024_causal"]
+    assert v["losers"] == ["sk2048_padding"]
+
+
+def test_sweep_gate_fails_on_losing_cell(tmp_path, monkeypatch):
+    """A committed record with a below-parity sweep cell must trip the
+    sweep gate."""
+    import tests.L0.test_kernel_defaults as mod
+
+    rec = {"parsed": {"extras": {
+        "bench_schema": 3,
+        "fused_softmax_sweep": {"sk2048_padding": {"ratio": 0.5}},
+    }}}
+    (tmp_path / "BENCH_r97.json").write_text(json.dumps(rec))
+    monkeypatch.setattr(mod, "REPO", str(tmp_path))
+    with pytest.raises(AssertionError, match="sk2048_padding ratio 0.5"):
+        mod.test_sweep_cells_not_losing()
+
+
 def test_gate_fails_on_losing_default(tmp_path, monkeypatch):
     """The failure path: a record showing a losing default must trip the
     gate (the r3 scenario — 0.17x recorded for a default-on path)."""

@@ -76,6 +76,47 @@ class TestResNet:
         assert params["conv1"]["w"].dtype == jnp.float32
 
 
+class TestStemSpaceToDepth:
+    """The r7 ResNet stem conv attempt (VERDICT r5 Weak #3): the 4x4/s1
+    space-to-depth form must be numerically identical to the 7x7/s2 SAME
+    stem — the bench's speedup comparison is only meaningful if the two
+    compute the same function."""
+
+    def test_s2d_stem_matches_standard(self):
+        import bench
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+        w7 = jax.random.normal(jax.random.PRNGKey(1), (7, 7, 3, 8)) * 0.1
+        std = jax.lax.conv_general_dilated(
+            x, w7, (2, 2), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        s2d = bench.stem_conv_s2d(x, w7)
+        assert s2d.shape == std.shape
+        np.testing.assert_allclose(np.asarray(s2d), np.asarray(std),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_s2d_stem_grads_match(self):
+        import bench
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 16, 16, 3))
+        w7 = jax.random.normal(jax.random.PRNGKey(1), (7, 7, 3, 4)) * 0.1
+
+        def loss_std(x, w):
+            return jnp.sum(jax.lax.conv_general_dilated(
+                x, w, (2, 2), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC")) ** 2)
+
+        def loss_s2d(x, w):
+            return jnp.sum(bench.stem_conv_s2d(x, w) ** 2)
+
+        gx1, gw1 = jax.grad(loss_std, argnums=(0, 1))(x, w7)
+        gx2, gw2 = jax.grad(loss_s2d, argnums=(0, 1))(x, w7)
+        np.testing.assert_allclose(np.asarray(gx2), np.asarray(gx1),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gw2), np.asarray(gw1),
+                                   rtol=1e-4, atol=1e-5)
+
+
 class TestGraftEntry:
     def test_entry_compiles(self):
         import __graft_entry__ as ge

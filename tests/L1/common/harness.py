@@ -203,6 +203,71 @@ def run_flagship_trajectory(steps: int = 8, seed: int = 0) -> List[float]:
     return losses
 
 
+def run_bert_trajectory(steps: int = 6, seed: int = 0) -> List[float]:
+    """Per-step losses of a toy BERT MLM run over PACKED varlen inputs
+    (segment ids + per-segment positions) through the flash path — the
+    golden-trajectory cell covering the r7 varlen fast path and the
+    bert_large bench construction end-to-end (ISSUE 5 satellite)."""
+    from apex_tpu.transformer import parallel_state
+    from apex_tpu.transformer.testing import BertConfig, BertModel
+
+    seq = 16
+    cfg = BertConfig(num_layers=2, hidden_size=32, num_attention_heads=2,
+                     vocab_size=64, max_position_embeddings=seq,
+                     tp_size=1, use_flash_attention=True,
+                     add_binary_head=False, num_tokentypes=0)
+    parallel_state.destroy_model_parallel()
+    mesh = parallel_state.initialize_model_parallel(1, 1)
+    model = BertModel(cfg)
+    params = model.shard_master(model.init_master(
+        jax.random.PRNGKey(seed)), 0)
+    opt = optimizers.FusedAdam(lr=1e-2, weight_decay=1e-4)
+    opt_state = opt.init(params)
+
+    # two fixed packed batches cycled (the harness convention): rows of
+    # two segments + a pad tail in its own bucket
+    lens = [(6, 7), (5, 9)]
+
+    def batch(i):
+        k = jax.random.fold_in(jax.random.PRNGKey(seed + 400), i % 2)
+        tokens = jax.random.randint(k, (_GLOBAL_BATCH, seq), 0,
+                                    cfg.vocab_size)
+        labels = jax.random.randint(jax.random.fold_in(k, 1),
+                                    (_GLOBAL_BATCH, seq), 0,
+                                    cfg.vocab_size)
+        a, b = lens[i % 2]
+        seg = jnp.asarray([0] * a + [1] * b + [2] * (seq - a - b),
+                          jnp.int32)
+        pos = jnp.asarray(list(range(a)) + list(range(b))
+                          + [0] * (seq - a - b), jnp.int32)
+        msk = jnp.asarray([1] * (a + b) + [0] * (seq - a - b), jnp.int32)
+        tile = lambda x: jnp.broadcast_to(x[None], (_GLOBAL_BATCH, seq))
+        return tokens, labels, tile(seg), tile(pos), tile(msk)
+
+    def step_body(params, opt_state, tokens, labels, seg, pos, msk):
+        def lossf(p):
+            losses, _ = model.apply(p, tokens, lm_labels=labels,
+                                    segment_ids=seg, position_ids=pos)
+            m = msk.astype(jnp.float32)
+            return jnp.sum(losses * m) / jnp.sum(m)
+
+        loss, grads = jax.value_and_grad(lossf)(params)
+        params, opt_state = opt.step(grads, opt_state, params)
+        return params, opt_state, loss
+
+    def replicated(*args):
+        return shard_map(step_body, mesh=mesh, in_specs=(P(),) * 7,
+                         out_specs=(P(),) * 3, check_rep=False)(*args)
+
+    step = jax.jit(replicated)
+    losses = []
+    for i in range(steps):
+        params, opt_state, loss = step(params, opt_state, *batch(i))
+        losses.append(float(loss))
+    parallel_state.destroy_model_parallel()
+    return losses
+
+
 # --- golden (stored) baselines ----------------------------------------------
 #
 # The reference's L1 compares runs against DUMPED baseline files

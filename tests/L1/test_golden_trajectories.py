@@ -25,6 +25,7 @@ import pytest
 from tests.L1.common.harness import (
     RunConfig,
     load_baseline,
+    run_bert_trajectory,
     run_flagship_trajectory,
     run_trajectory,
     save_baseline,
@@ -71,6 +72,13 @@ def _check(name, traj):
 @pytest.mark.parametrize("name", sorted(CELLS))
 def test_golden_trajectory(name):
     _check(name, run_trajectory(CELLS[name]))
+
+
+def test_golden_trajectory_bert_toy_varlen():
+    """Toy BERT MLM over packed varlen inputs (segment ids + restarting
+    positions, flash path) — covers the r7 varlen fast path and the
+    bert_large bench construction (ISSUE 5 satellite)."""
+    _check("bert_toy_varlen", run_bert_trajectory(steps=6))
 
 
 def test_golden_trajectory_gpt1p3b_toy():
