@@ -16,6 +16,7 @@ from apex_tpu.checkpoint.checkpoint import (
     restore_checkpoint,
     save_checkpoint,
     shard_file,
+    shard_file_coords,
     step_dir,
     verify_checkpoint,
 )
@@ -28,6 +29,7 @@ __all__ = [
     "verify_checkpoint",
     "latest_step",
     "shard_file",
+    "shard_file_coords",
     "step_dir",
     "CheckpointCorruptionError",
     "RetryPolicy",

@@ -35,6 +35,7 @@ per-process and re-shard via device_put.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import json
 import os
 import shutil
@@ -47,6 +48,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from apex_tpu.multi_tensor import flat as _flat
+
 _MANIFEST = "manifest.json"
 _ARRAYS = "arrays.npz"
 _PACK = "arrays.pack"
@@ -55,8 +58,23 @@ _PACK_ALIGN = 64
 
 
 def shard_file(rank: int) -> str:
-    """On-disk name of one shard's array file in a sharded checkpoint."""
+    """On-disk name of one shard's array file in a format-3 (single-axis)
+    sharded checkpoint."""
     return f"shard_{int(rank):05d}.npz"
+
+
+def shard_file_coords(coords) -> str:
+    """On-disk name of one mesh coordinate's array file in a format-4
+    (multi-axis) sharded checkpoint: ``shard_<c0>_<c1>_..._<ck>.npz``
+    with one coordinate per mesh axis, in the manifest ``topology``'s
+    ``mesh_axes`` order."""
+    return "shard_" + "_".join(str(int(c)) for c in coords) + ".npz"
+
+
+def _coord_key(coords) -> str:
+    """Manifest key of one shard coordinate (per-leaf ``crc32_shards``
+    dict): the leaf's own lead-axis coordinates joined with ``_``."""
+    return "_".join(str(int(c)) for c in coords)
 
 
 class CheckpointCorruptionError(RuntimeError):
@@ -233,6 +251,7 @@ def save_checkpoint(
     blocking: bool = True,
     retry: Optional[RetryPolicy] = None,
     shard_axis: Optional[str] = None,
+    shard_axes: Optional[Any] = None,
 ) -> str:
     """Write ``tree`` as checkpoint ``step`` under ``ckpt_dir``.
 
@@ -271,6 +290,20 @@ def save_checkpoint(
     in the unsharded format.  Sharded saves require ``shardings`` and
     are npz-only (``packed=True`` is rejected).
 
+    ``shard_axes`` — the multi-axis generalization (**format 4**): an
+    *ordered* mapping of mesh axis name → size (e.g. ``{"data": 4,
+    "pipeline": 1, "tensor": 2}``).  Leaves whose spec LEADS with one or
+    more of those axis names (one name per leading dim, in dim order)
+    are stacks of per-coordinate partitions; each mesh coordinate's
+    slice goes to ``shard_<c0>_<c1>_..._<ck>.npz`` (coordinates in
+    ``shard_axes`` order; axes a leaf is not sharded over sit at 0) with
+    a per-coordinate CRC32 digest (``crc32_shards`` dict keyed by the
+    leaf's own lead coordinates).  The manifest's ``topology`` record
+    carries the full ``mesh_axes`` shape, and restore re-partitions
+    across any N→M reshape of the mesh (``docs/resilience.md`` "3D
+    topologies").  Mutually exclusive with ``shard_axis``; format-3
+    checkpoints keep restoring through the same path.
+
     Returns the checkpoint directory path.
     """
     # Only process 0 writes; the guard precedes any device_get so non-writing
@@ -286,12 +319,21 @@ def save_checkpoint(
 
     _async.wait_for_save()
 
-    if shard_axis is not None and shardings is None:
+    if shard_axis is not None and shard_axes is not None:
+        raise ValueError("pass shard_axis (format 3) or shard_axes "
+                         "(format 4), not both")
+    if (shard_axis is not None or shard_axes is not None) \
+            and shardings is None:
         raise ValueError(
-            "shard_axis requires shardings: the PartitionSpec tree is what "
-            "identifies which leaves are per-rank partitions")
-    if shard_axis is not None and packed:
+            "shard_axis/shard_axes requires shardings: the PartitionSpec "
+            "tree is what identifies which leaves are per-rank partitions")
+    if (shard_axis is not None or shard_axes is not None) and packed:
         raise ValueError("sharded checkpoints are npz-only (packed=False)")
+    if shard_axes is not None:
+        shard_axes = {str(a): int(n) for a, n in dict(shard_axes).items()}
+        if not shard_axes or any(n < 1 for n in shard_axes.values()):
+            raise ValueError(f"invalid shard_axes {shard_axes!r}: need at "
+                             "least one axis, every size >= 1")
 
     leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
     spec_map = _spec_map(shardings, tree) if shardings is not None else {}
@@ -316,6 +358,10 @@ def save_checkpoint(
     n_shards: Optional[int] = None
     mesh_shape: Optional[dict] = None
     shard_arrays: list = []
+    # format 4: mesh-coordinate tuple (over ALL shard_axes, in order) ->
+    # {leaf key: partition}; populated only for multi-axis saves
+    shard_maps: dict = {}
+    any_multi = False
     for path, leaf in leaves:
         # (None leaves never appear here: tree_flatten treats None as an
         # empty subtree, so None-valued fields are simply absent and
@@ -349,6 +395,33 @@ def save_checkpoint(
         spec = spec_map.get(ptuple)
         if spec is not None:
             entry["spec"] = _spec_to_json(spec)
+        if shard_axes is not None:
+            lead = _flat.spec_lead_axes(spec, shard_axes)
+            if lead:
+                if val.ndim < len(lead):
+                    raise ValueError(
+                        f"leaf {key} has spec leading with {len(lead)} "
+                        f"mesh axes {lead} but only {val.ndim} dims to "
+                        "partition")
+                for i, ax in enumerate(lead):
+                    if val.shape[i] != shard_axes[ax]:
+                        raise ValueError(
+                            f"leaf {key} dim {i} has size {val.shape[i]} "
+                            f"but its spec shards it over {ax!r} "
+                            f"(size {shard_axes[ax]})")
+                entry["shard_axes"] = lead
+                entry["replicated_shards"] = _flat.is_replicated_stack(
+                    val, len(lead))
+                any_multi = True
+                for c in itertools.product(
+                        *(range(shard_axes[a]) for a in lead)):
+                    fullc = _leaf_full_coord(entry, c, shard_axes)
+                    shard_maps.setdefault(fullc, {})[key] = val[c]
+                manifest["leaves"][key] = entry
+                continue
+            manifest["leaves"][key] = entry
+            arrays[key] = val
+            continue
         if shard_axis is not None and _spec_leads_with(spec, shard_axis):
             if val.ndim == 0:
                 raise ValueError(
@@ -388,19 +461,28 @@ def save_checkpoint(
                                 "n_shards": n_shards}
         if mesh_shape is not None:
             manifest["topology"]["mesh_shape"] = mesh_shape
+    elif any_multi:
+        manifest["format"] = 4
+        manifest["topology"] = {"mesh_axes": dict(shard_axes)}
+        if mesh_shape is not None:
+            manifest["topology"]["mesh_shape"] = mesh_shape
 
     # everything below is pure host/disk work on the snapshot — safe to run
     # on the background writer thread
     if blocking:
         _write_checkpoint_files(ckpt_dir, step, manifest, arrays,
                                 packed=packed, keep=keep, retry=retry,
-                                shard_arrays=shard_arrays)
+                                shard_arrays=shard_arrays,
+                                shard_maps=shard_maps,
+                                shard_axes=shard_axes)
     else:
         _async.submit_save(
             lambda: _write_checkpoint_files(ckpt_dir, step, manifest, arrays,
                                             packed=packed, keep=keep,
                                             retry=retry,
-                                            shard_arrays=shard_arrays),
+                                            shard_arrays=shard_arrays,
+                                            shard_maps=shard_maps,
+                                            shard_axes=shard_axes),
             label=f"{ckpt_dir}:step_{int(step)}")
     return step_dir(ckpt_dir, step)
 
@@ -417,11 +499,22 @@ def _spec_leads_with(spec, axis: str) -> bool:
     return head == axis
 
 
+def _leaf_full_coord(entry: dict, coords, shard_axes: dict) -> tuple:
+    """Full mesh coordinate of one leaf shard: the leaf's own lead-axis
+    ``coords`` placed at their axes' positions in ``shard_axes`` order,
+    zeros elsewhere (the format-4 file-location rule)."""
+    lead = entry["shard_axes"]
+    return tuple(coords[lead.index(a)] if a in lead else 0
+                 for a in shard_axes)
+
+
 def _write_checkpoint_files(ckpt_dir: str, step: int, manifest: dict,
                             arrays: dict, *, packed: bool,
                             keep: Optional[int],
                             retry: Optional[RetryPolicy],
-                            shard_arrays: Optional[list] = None) -> str:
+                            shard_arrays: Optional[list] = None,
+                            shard_maps: Optional[dict] = None,
+                            shard_axes: Optional[dict] = None) -> str:
     """Disk phase of a save: tmp dir -> arrays + manifest -> atomic rename ->
     latest marker -> keep-GC.  Retries the whole tmp-dir write on transient
     storage errors (each attempt starts from a fresh tmp dir)."""
@@ -432,7 +525,14 @@ def _write_checkpoint_files(ckpt_dir: str, step: int, manifest: dict,
     for k, entry in manifest["leaves"].items():
         if k in arrays:
             entry["crc32"] = zlib.crc32(arrays[k].tobytes()) & 0xFFFFFFFF
-        else:  # sharded leaf: one digest per rank's partition
+        elif "shard_axes" in entry:  # format 4: digest per mesh coordinate
+            entry["crc32_shards"] = {
+                _coord_key(c): zlib.crc32(
+                    shard_maps[_leaf_full_coord(entry, c, shard_axes)][k]
+                    .tobytes()) & 0xFFFFFFFF
+                for c in itertools.product(
+                    *(range(shard_axes[a]) for a in entry["shard_axes"]))}
+        else:  # format 3: one digest per rank's partition
             entry["crc32_shards"] = [
                 zlib.crc32(sh[k].tobytes()) & 0xFFFFFFFF
                 for sh in shard_arrays]
@@ -442,7 +542,8 @@ def _write_checkpoint_files(ckpt_dir: str, step: int, manifest: dict,
     for attempt in range(retry.max_attempts):
         try:
             _write_step_dir_once(ckpt_dir, step, manifest, arrays,
-                                 packed=packed, shard_arrays=shard_arrays)
+                                 packed=packed, shard_arrays=shard_arrays,
+                                 shard_maps=shard_maps)
             break
         except retry.retryable as e:
             last_err = e
@@ -471,7 +572,8 @@ def _write_checkpoint_files(ckpt_dir: str, step: int, manifest: dict,
 
 def _write_step_dir_once(ckpt_dir: str, step: int, manifest: dict,
                          arrays: dict, *, packed: bool,
-                         shard_arrays: Optional[list] = None) -> None:
+                         shard_arrays: Optional[list] = None,
+                         shard_maps: Optional[dict] = None) -> None:
     """One attempt at writing + committing ``step_<N>/``."""
     os.makedirs(ckpt_dir, exist_ok=True)
     final = step_dir(ckpt_dir, step)
@@ -487,6 +589,13 @@ def _write_step_dir_once(ckpt_dir: str, step: int, manifest: dict,
             p = os.path.join(tmp, shard_file(r))
             _fault("write_shard", p)
             np.savez(p, **sh)
+    if shard_maps:
+        # format 4: per-mesh-coordinate partition files, same fault
+        # event and same atomic-commit guarantee
+        for coords in sorted(shard_maps):
+            p = os.path.join(tmp, shard_file_coords(coords))
+            _fault("write_shard", p)
+            np.savez(p, **shard_maps[coords])
     if packed:
         from apex_tpu import _native
 
@@ -543,6 +652,8 @@ def _load_manifest_and_data(d: str, *, verify: bool):
         raise
     pack_path = os.path.join(d, _PACK)
     shard_data: list = []
+    coord_maps: dict = {}
+    mesh_axes = dict(manifest.get("topology", {}).get("mesh_axes") or {})
     try:
         if os.path.exists(pack_path):  # format 2: flat superblock
             buf = np.fromfile(pack_path, np.uint8)
@@ -559,6 +670,18 @@ def _load_manifest_and_data(d: str, *, verify: bool):
             for r in range(manifest.get("topology", {}).get("n_shards", 0)):
                 with np.load(os.path.join(d, shard_file(r))) as npz:
                     shard_data.append({k: npz[k] for k in npz.files})
+            if mesh_axes:  # format 4: per-mesh-coordinate files
+                needed = set()
+                for e in manifest["leaves"].values():
+                    if "shard_axes" not in e:
+                        continue
+                    for c in itertools.product(
+                            *(range(mesh_axes[a]) for a in e["shard_axes"])):
+                        needed.add(_leaf_full_coord(e, c, mesh_axes))
+                for fullc in sorted(needed):
+                    with np.load(os.path.join(
+                            d, shard_file_coords(fullc))) as npz:
+                        coord_maps[fullc] = {k: npz[k] for k in npz.files}
     except Exception as e:
         # truncated pack (frombuffer ValueError), truncated/garbled npz
         # (zipfile.BadZipFile, EOFError, OSError, KeyError), missing
@@ -589,10 +712,47 @@ def _load_manifest_and_data(d: str, *, verify: bool):
             parts.append(sh[k])
         if len(parts) == len(shard_data):
             data[k] = np.stack(parts)
+    for k, e in manifest["leaves"].items():
+        if "shard_axes" not in e:
+            continue
+        # format 4: reassemble [n_a, n_b, ..., *content] from the
+        # per-coordinate files (coordinates iterate in C-order over the
+        # leaf's lead axes, so stack+reshape inverts the save split)
+        try:
+            lead_shape = tuple(mesh_axes[a] for a in e["shard_axes"])
+        except KeyError as exc:
+            # valid-JSON but damaged manifest: a leaf names a shard axis
+            # absent from topology.mesh_axes — under verify this is a
+            # corrupt checkpoint (so restore_resilient's fallback walk
+            # can move on to an older intact step), not a raw KeyError
+            if verify:
+                raise CheckpointCorruptionError(
+                    f"checkpoint at {d}: leaf {k!r} is sharded over axis "
+                    f"{exc} missing from topology mesh_axes "
+                    f"{sorted(mesh_axes)}") from exc
+            raise
+        parts = []
+        for c in itertools.product(*(range(n) for n in lead_shape)):
+            sh = coord_maps.get(_leaf_full_coord(e, c, mesh_axes), {})
+            if k not in sh:
+                problems.append(f"missing {k!r} at mesh coordinate {c}")
+                continue
+            if verify and "crc32_shards" in e:
+                got = zlib.crc32(np.asarray(sh[k]).tobytes()) & 0xFFFFFFFF
+                want = e["crc32_shards"].get(_coord_key(c))
+                if got != want:
+                    problems.append(
+                        f"CRC32 mismatch for {k!r} at mesh coordinate "
+                        f"{c}: stored digest {want}, bytes on disk hash "
+                        f"to {got}")
+            parts.append(sh[k])
+        if len(parts) == int(np.prod(lead_shape)):
+            data[k] = np.stack(parts).reshape(
+                lead_shape + tuple(parts[0].shape))
     if verify:
         for k, e in manifest["leaves"].items():
             if k not in data:
-                if "shard_axis" not in e:  # sharded misses named above
+                if "shard_axis" not in e and "shard_axes" not in e:
                     problems.append(f"missing stored array {k!r}")
                 continue
             want = e.get("crc32")
@@ -696,7 +856,8 @@ def restore_checkpoint(
     def _materialize(key: str, entry: dict, want_dtype=None,
                      want_shape=None):
         val = data[key]
-        if (want_shape is not None and "shard_axis" in entry
+        if (want_shape is not None
+                and ("shard_axis" in entry or "shard_axes" in entry)
                 and tuple(val.shape) != tuple(want_shape)):
             val = _reshard_stack(val, entry, tuple(want_shape), key)
         if entry.get("stored_dtype") == "uint16_bits":
@@ -768,30 +929,20 @@ def restore_checkpoint(
 
 def _reshard_stack(val: np.ndarray, entry: dict, want_shape: tuple,
                    key: str) -> np.ndarray:
-    """Re-partition a sharded leaf's stored ``[N, ...]`` stack to the
-    target's ``[M, ...]`` layout (restore_checkpoint's "cross-topology
-    reshard" contract; operates on the STORED dtype, before any
-    precision-portability cast)."""
-    if entry.get("replicated_shards"):
-        # per-rank replicated value (broadcast step counter): rank 0
-        # speaks for all ranks on the new topology
-        if val.shape[1:] != tuple(want_shape[1:]):
-            raise ValueError(
-                f"cannot reshard replicated leaf {key!r}: per-rank shape "
-                f"{val.shape[1:]} != target per-rank shape "
-                f"{tuple(want_shape[1:])}")
-        # contiguous copy: the caller may still .view() the raw-bits
-        # stored dtype, which a broadcast view cannot support
-        return np.ascontiguousarray(np.broadcast_to(val[0], want_shape))
-    # flat-buffer stack: C-order flatten IS the concat of the N
-    # partitions in rank order; the pad/trim contract lives in ONE
-    # place (flat.repartition_flat), shared with the in-memory
-    # reshard_zero_state so on-disk and live semantics cannot diverge
-    from apex_tpu.multi_tensor.flat import repartition_flat
-
-    out = repartition_flat(val, int(np.prod(want_shape)),
-                           label=f"sharded leaf {key!r}")
-    return out.reshape(want_shape)
+    """Re-partition a sharded leaf's stored stack to the target's layout
+    (restore_checkpoint's "cross-topology reshard" contract; operates on
+    the STORED dtype, before any precision-portability cast).  Format-3
+    leaves carry one lead axis, format-4 leaves one per mesh axis named
+    in ``shard_axes``; both route through ONE implementation
+    (:func:`apex_tpu.multi_tensor.flat.reshard_stack` — C-order flatten
+    + the repartition_flat pad/trim contract, replicated stacks
+    re-broadcast coordinate 0), shared with the in-memory
+    reshard_zero_state/reshard_tree so on-disk and live semantics
+    cannot diverge."""
+    n_lead = len(entry["shard_axes"]) if "shard_axes" in entry else 1
+    return _flat.reshard_stack(val, n_lead, want_shape,
+                               replicated=bool(entry.get("replicated_shards")),
+                               label=f"sharded leaf {key!r}")
 
 
 def _filter_spec_entry(part, mesh: Mesh):

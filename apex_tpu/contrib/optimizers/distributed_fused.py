@@ -70,37 +70,54 @@ class ShardedOptState(NamedTuple):
     exp_avg_sq: jnp.ndarray  # [shard] f32 (2nd moment)
 
 
-def reshard_zero_state(opt_state: ShardedOptState, *, n_shards: int,
-                       schema: FlatSchema) -> ShardedOptState:
+def reshard_zero_state(opt_state: ShardedOptState, *,
+                       n_shards: Optional[int] = None,
+                       schema: FlatSchema,
+                       lead_shape=None) -> ShardedOptState:
     """Re-partition a STACKED per-rank :class:`ShardedOptState` (leading
-    ``[old_n]`` axis on every leaf, the layout the flagship train step
-    carries) onto a new shard count — the in-memory half of the elastic
+    stack axes on every leaf, the layout the flagship train step
+    carries) onto a new topology — the in-memory half of the elastic
     cross-topology story (the on-disk half lives in
     ``checkpoint.restore_checkpoint``'s sharded-manifest reshard).
 
-    The flat-buffer leaves (``exp_avg``/``exp_avg_sq``) concatenate in
-    rank order to the logical superblock, then re-split ``n_shards``
-    ways against the TARGET ``schema`` (whose ``total`` is padded to
-    ``128·n_shards`` — per-leaf offsets are topology-invariant, only the
-    tail padding moves, so growth zero-fills and shrinkage may drop
-    only all-zero tail padding; dropping real state raises).  The
-    broadcast ``step`` counter re-broadcasts rank 0.  Host-side numpy —
-    this runs once per mesh rebuild, not per step."""
-    from apex_tpu.multi_tensor.flat import repartition_flat
+    ``n_shards`` — single-axis form: the leading ``[old_n]`` stack
+    re-partitions to ``[n_shards, total/n_shards]``.  ``lead_shape`` —
+    multi-axis form (e.g. ``(dp, pp, tp)``): the flat leaves re-stack to
+    ``[*lead_shape, total/prod(lead_shape)]``, linearizing the old stack
+    axes in C order (the linearized-world ZeRO layout).  Either way the
+    flat-buffer leaves (``exp_avg``/``exp_avg_sq``) concatenate in rank
+    order to the logical superblock, then re-split against the TARGET
+    ``schema`` (whose ``total`` is padded to ``128·world`` — per-leaf
+    offsets are topology-invariant, only the tail padding moves, so
+    growth zero-fills and shrinkage may drop only all-zero tail padding;
+    dropping real state raises).  The broadcast ``step`` counter
+    re-broadcasts coordinate 0.  Host-side numpy — this runs once per
+    mesh rebuild, not per step; routes through
+    :func:`apex_tpu.multi_tensor.flat.reshard_stack`, the same
+    implementation the checkpoint reshard uses."""
+    from apex_tpu.multi_tensor.flat import reshard_stack
 
-    old_n = int(np.asarray(opt_state.step).shape[0])
-    shard = schema.total // n_shards
+    if lead_shape is None:
+        if n_shards is None:
+            raise ValueError("pass n_shards or lead_shape")
+        lead_shape = (int(n_shards),)
+    lead_shape = tuple(int(x) for x in lead_shape)
+    world = int(np.prod(lead_shape))
+    shard = schema.total // world
+    old_step = np.asarray(jax.device_get(opt_state.step))
+    n_lead_old = old_step.ndim  # step content is scalar per rank
 
     def _flat(leaf) -> jnp.ndarray:
         a = np.asarray(jax.device_get(leaf))
-        out = repartition_flat(a, n_shards * shard,
-                               label=f"opt shard stack ({old_n}->"
-                                     f"{n_shards})")
-        return jnp.asarray(out.reshape(n_shards, shard))
+        out = reshard_stack(a, n_lead_old, (*lead_shape, shard),
+                            label=f"opt shard stack ({old_step.shape}->"
+                                  f"{lead_shape})")
+        return jnp.asarray(out)
 
-    step0 = np.asarray(jax.device_get(opt_state.step))[0]
     return ShardedOptState(
-        step=jnp.broadcast_to(jnp.asarray(step0), (n_shards,)),
+        step=jnp.asarray(reshard_stack(old_step, n_lead_old, lead_shape,
+                                       replicated=True,
+                                       label="opt step counter")),
         exp_avg=_flat(opt_state.exp_avg),
         exp_avg_sq=_flat(opt_state.exp_avg_sq),
     )
@@ -115,7 +132,12 @@ class DistributedShardedOptimizer:
     eps: float = 1e-8
     weight_decay: float = 0.0
     bias_correction: bool = True
-    axis_name: str = "data"
+    # multi-axis meshes: ``axis_name`` may be a TUPLE of mesh axes (the
+    # linearized-world ZeRO layout — shards/collectives span the whole
+    # dp×pp×tp block; the caller feeds REPLICATED global grads, which
+    # the mesh-wide psum_scatter sums world-fold and ``grad_average``
+    # divides back out — exact for power-of-two worlds)
+    axis_name: Any = "data"
     grad_average: bool = True
     e5m2_allgather: bool = False  # reference distributed_fused_lamb.py:93
     # memory-fit knobs (see module docstring); None = fp32 (r5 behavior)

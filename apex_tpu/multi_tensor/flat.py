@@ -84,6 +84,145 @@ def repartition_flat(flat: np.ndarray, new_size: int, *,
     return flat
 
 
+def reshard_stack(val: np.ndarray, n_lead: int, want_shape, *,
+                  replicated: bool = False,
+                  label: str = "sharded stack") -> np.ndarray:
+    """Re-partition one stacked sharded leaf across topologies.
+
+    ``val`` is a ``[n_a, n_b, ..., *content]`` stack whose first
+    ``n_lead`` dims are per-rank stack axes (one per mesh axis the leaf
+    is sharded over, in mesh-axis order); ``want_shape`` is the target
+    topology's stacked shape.  The contract generalizing
+    :func:`repartition_flat` to the multi-axis mesh:
+
+    - ``replicated`` — every coordinate holds the same per-rank value
+      (broadcast step counters): coordinate (0, ..., 0) speaks for the
+      whole new topology; the content shape must match exactly.
+    - otherwise the leaf's **logical value is its C-order flatten**:
+      leading stack dims linearize in mesh-axis order (the linearized-
+      world ZeRO layout), and a stack dim sharding a contiguous leading
+      content dim (pp layer stacks ``[pp, L/pp, ...]``) merges into it
+      exactly.  The flatten re-partitions under the pad/trim contract
+      (:func:`repartition_flat` — only all-zero schema tail padding may
+      grow or shrink) and reshapes to ``want_shape``.  Layouts whose
+      logical merge is NOT C-contiguous (e.g. a 2-D weight sliced along
+      its second dim, stacked on a leading axis) are outside the
+      contract — store those leaves replicated (master form) or slice
+      the leading content dim instead.
+    """
+    val = np.asarray(val)
+    want_shape = tuple(int(x) for x in want_shape)
+    if n_lead >= val.ndim + 1 and not replicated:
+        raise ValueError(
+            f"cannot reshard {label}: {n_lead} stack axes on a "
+            f"{val.ndim}-D array")
+    if replicated:
+        n_lead = min(n_lead, val.ndim)
+        content = val[(0,) * n_lead]
+        # target lead-dim count may differ (a 3-axis save restoring
+        # into a 1-axis state); the content tail must match exactly
+        tail = want_shape[len(want_shape) - content.ndim:] if content.ndim \
+            else ()
+        if content.shape != tuple(tail):
+            raise ValueError(
+                f"cannot reshard replicated {label}: per-rank shape "
+                f"{content.shape} != target per-rank shape {tuple(tail)}")
+        # contiguous copy: callers may .view() raw-bits stored dtypes,
+        # which a broadcast view cannot support
+        return np.ascontiguousarray(np.broadcast_to(content, want_shape))
+    out = repartition_flat(val, int(np.prod(want_shape, dtype=np.int64)),
+                           label=label)
+    return out.reshape(want_shape)
+
+
+def spec_lead_axes(spec, axes) -> list:
+    """Leading mesh-axis names of a PartitionSpec: walk entries from dim
+    0 while each names exactly one axis in ``axes`` (str, or a 1-tuple);
+    stop at the first entry that does not."""
+    lead = []
+    for part in (spec or ()):
+        if isinstance(part, (tuple, list)):
+            part = part[0] if len(part) == 1 else None
+        if part in axes:
+            lead.append(part)
+        else:
+            break
+    return lead
+
+
+def is_replicated_stack(val, n_lead: int) -> bool:
+    """Per-rank replicated broadcast value: scalar content (ndim ==
+    n_lead) with every coordinate equal — the multi-axis form of the
+    format-3 1-D rule.  A >=1-D content stack is by contract a data
+    partition even when rank-identical (fresh all-zero moments must
+    reshard by concat)."""
+    val = np.asarray(val)
+    if val.ndim != n_lead:
+        return False
+    flat = val.reshape(-1)
+    return bool(np.all(flat == flat[0]))
+
+
+def reshard_tree(tree, spec_from, spec_to, *, target,
+                 axes_from, axes_to=None, label: str = "state"):
+    """Sharding-aware tree re-partitioner: every leaf of ``tree`` whose
+    ``spec_from`` spec leads with mesh-axis names is re-stacked to the
+    shape of the corresponding ``target`` leaf (an N→M reshape of the
+    (dp, tp, pp) topology — the in-memory twin of the format-4
+    checkpoint reshard, sharing :func:`reshard_stack` so on-disk and
+    live semantics cannot diverge).
+
+    ``spec_from`` / ``spec_to`` — structure-prefix PartitionSpec trees
+    for the source and target states (the same object is fine when the
+    layout convention is unchanged); ``axes_from`` / ``axes_to`` —
+    mesh-axis name → size mappings of the two topologies.  Replicated
+    leaves (no leading axis names) pass through unchanged.  Host-side
+    numpy — this runs once per mesh rebuild, not per step."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    axes_to = dict(axes_to if axes_to is not None else axes_from)
+
+    def _expand(spec_tree, value_tree):
+        flat = []
+
+        def _collect(spec, subtree):
+            if isinstance(spec, NamedSharding):
+                spec = spec.spec
+            n = len(jax.tree_util.tree_leaves(subtree))
+            flat.extend([spec] * n)
+
+        jax.tree_util.tree_map(
+            _collect, spec_tree, value_tree,
+            is_leaf=lambda x: x is None
+            or isinstance(x, (PartitionSpec, NamedSharding)))
+        return flat
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    tgt_leaves = jax.tree_util.tree_leaves(target)
+    specs_f = _expand(spec_from, tree)
+    specs_t = _expand(spec_to, target)
+    if not (len(leaves) == len(tgt_leaves) == len(specs_f) == len(specs_t)):
+        raise ValueError(
+            f"reshard_tree({label}): tree/target/spec leaf counts "
+            f"disagree ({len(leaves)}/{len(tgt_leaves)}/{len(specs_f)}/"
+            f"{len(specs_t)})")
+    out = []
+    for i, (leaf, tgt) in enumerate(zip(leaves, tgt_leaves)):
+        lead_f = spec_lead_axes(specs_f[i], axes_from)
+        lead_t = spec_lead_axes(specs_t[i], axes_to)
+        want = tuple(tgt.shape)
+        if not lead_f and not lead_t:
+            out.append(leaf)
+            continue
+        val = np.asarray(jax.device_get(leaf))
+        res = reshard_stack(
+            val, len(lead_f), want,
+            replicated=is_replicated_stack(val, len(lead_f)),
+            label=f"{label} leaf {i}")
+        out.append(jnp.asarray(res))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def make_schema(tree, *, align: int = 128, total_multiple_of: int = 1) -> FlatSchema:
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     shapes, dtypes, offsets, sizes = [], [], [], []

@@ -49,6 +49,7 @@ from apex_tpu.resilience.elastic import (  # noqa: F401
     ElasticResult,
     Watchdog,
     WatchdogTimeout,
+    best_surviving_submesh,
     largest_divisor_submesh,
     restore_zero_checkpoint,
     run_elastic_training,
@@ -77,6 +78,7 @@ __all__ = [
     "StepGuard",
     "Watchdog",
     "WatchdogTimeout",
+    "best_surviving_submesh",
     "first_nonfinite_leaf",
     "global_grad_norm",
     "in_flight",

@@ -215,27 +215,71 @@ class DeviceLoss:
                                   detail=f"injected at poll {self.polls}")
 
 
-def corrupt_shard(ckpt_dir: str, step: int, rank: int) -> str:
-    """Flip one byte in rank ``rank``'s partition file of a sharded
-    checkpoint — exactly that shard's CRC32 verification must fail while
-    every other shard file stays intact.  Returns the damaged path."""
-    path = os.path.join(_ckpt.step_dir(ckpt_dir, step),
-                        _ckpt.shard_file(rank))
-    _flip_byte(path, os.path.getsize(path) // 2)
+def corrupt_shard(ckpt_dir: str, step: int, rank) -> str:
+    """Flip one byte in one partition file of a sharded checkpoint —
+    exactly that shard's CRC32 verification must fail while every other
+    shard file stays intact.  ``rank`` is an int for a format-3
+    (single-axis) save, or a mesh-coordinate tuple like ``(d, p, t)``
+    for a format-4 multi-axis save (so chaos can hit a tp or pp leg's
+    shard file specifically).  Returns the damaged path."""
+    import zipfile
+
+    name = (_ckpt.shard_file_coords(rank) if isinstance(rank, (tuple, list))
+            else _ckpt.shard_file(rank))
+    path = os.path.join(_ckpt.step_dir(ckpt_dir, step), name)
+    # flip inside the largest entry's DATA span, not the blind file
+    # middle: a multi-array npz has zip framing (local headers) between
+    # entries whose bytes nothing validates — a flip landing there
+    # would be silently tolerated and the chaos case would prove
+    # nothing (found the hard way on the 3-D shard set)
+    with zipfile.ZipFile(path) as z:
+        info = max(z.infolist(), key=lambda i: i.compress_size)
+    with open(path, "rb") as f:
+        f.seek(info.header_offset)
+        hdr = f.read(30)
+    n_name = int.from_bytes(hdr[26:28], "little")
+    n_extra = int.from_bytes(hdr[28:30], "little")
+    data_off = info.header_offset + 30 + n_name + n_extra
+    _flip_byte(path, data_off + max(0, info.compress_size // 2))
     return path
 
 
-def slow_collective(step_fn, *, at_step: int, delay: float):
+def slow_collective(step_fn, *, at_step: int, delay: float,
+                    axis: Optional[str] = None,
+                    stale_devices=None, watchdog=None,
+                    telemetry=None):
     """Wrap ``step_fn`` so its ``at_step``-th invocation stalls ``delay``
     seconds before stepping — a straggling (or hung, for large
     ``delay``) collective as seen from the host.  The watchdog armed
-    around the step must overrun and escalate."""
+    around the step must overrun and escalate.
+
+    Per-axis form (ISSUE 6): ``axis`` names the mesh axis whose
+    collective is stalling (recorded in the ``fault_injected`` telemetry
+    event when a bus is given, so a chaos stream says WHICH dp/tp/pp
+    group the fault targeted).  ``stale_devices`` + ``watchdog``: while
+    the stall runs, every device EXCEPT the stale ones is given a fresh
+    ``watchdog.beat`` — the hang report's per-axis attribution then
+    points at the stalled group, exactly what a platform health poller
+    would produce for a wedged tp ring."""
     calls = {"n": 0}
 
     def wrapped(state, batch):
         calls["n"] += 1
         if calls["n"] == at_step:
-            time.sleep(delay)
+            if telemetry is not None:
+                telemetry.emit("fault_injected", kind="slow_collective",
+                               axis=axis, at_step=calls["n"],
+                               delay_s=float(delay))
+            if watchdog is not None and stale_devices is not None:
+                stale = {getattr(d, "id", d) for d in stale_devices}
+                deadline = time.monotonic() + delay
+                while time.monotonic() < deadline:
+                    for d in watchdog.device_ids:
+                        if d not in stale:
+                            watchdog.beat(d)
+                    time.sleep(min(0.02, delay / 10))
+            else:
+                time.sleep(delay)
         return step_fn(state, batch)
 
     wrapped.calls = calls

@@ -36,6 +36,15 @@ reference ecosystem (PAPERS.md):
   surviving submesh and resumes from the newest *intact* sharded
   checkpoint.
 
+ISSUE 6 generalized all of this from the single "data" axis to the
+full dp×tp×pp ``parallel_state`` mesh: format-4 multi-axis sharded
+checkpoints (``shard_axes``, shard files keyed by mesh coordinates),
+cross-topology restore across any (dp, tp, pp) reshape,
+:func:`best_surviving_submesh` recovery (largest-divisor per axis,
+shrinking dp before tp before pp), and per-axis watchdog stall
+attribution (``Watchdog(mesh=...)`` → ``axis_groups`` in the hang
+report).  See docs/resilience.md "3D topologies".
+
 Escalation is cooperative, like everything in the grace-period design:
 a watchdog firing flips the handler's stop flag, and the loop (which is
 presumed stuck *slow*, not stuck *dead*) saves and exits at the next
@@ -113,7 +122,9 @@ class Watchdog:
                  on_hang: Optional[Callable[[dict], None]] = None,
                  devices: Optional[Sequence] = None,
                  history: int = 256, poll_interval: Optional[float] = None,
-                 telemetry=None):
+                 telemetry=None, mesh=None,
+                 mesh_axes: Optional[dict] = None,
+                 device_coords: Optional[dict] = None):
         self.timeout = timeout
         self.handler = handler
         self.on_hang = on_hang
@@ -122,6 +133,26 @@ class Watchdog:
         # postmortem); emitted from the monitor thread — the bus is
         # thread-safe by contract
         self.telemetry = telemetry
+        # per-axis attribution (ISSUE 6): give the watchdog the mesh
+        # decomposition and its hang report names the dp/tp/pp GROUP
+        # that stalled, not just the device.  Either pass ``mesh`` (a
+        # jax.sharding.Mesh — axis names and coordinates are derived)
+        # or explicit ``mesh_axes`` ({axis: size}, mesh order) +
+        # ``device_coords`` ({device id: coordinate tuple}).
+        if mesh is not None and mesh_axes is None:
+            import numpy as _np
+
+            arr = _np.asarray(mesh.devices)
+            mesh_axes = {str(a): int(n)
+                         for a, n in zip(mesh.axis_names, arr.shape)}
+            device_coords = {
+                getattr(arr[idx], "id", arr[idx]): tuple(int(i)
+                                                         for i in idx)
+                for idx in _np.ndindex(arr.shape)}
+            if devices is None:
+                devices = list(arr.reshape(-1))
+        self.mesh_axes = dict(mesh_axes) if mesh_axes else None
+        self.device_coords = dict(device_coords) if device_coords else None
         if devices is None:
             import jax
 
@@ -214,18 +245,80 @@ class Watchdog:
                 if t is not None and d not in self.lost]
         return max(ages) if ages else None
 
+    def axis_report(self) -> Optional[dict]:
+        """Per-axis stall attribution (requires mesh_axes/device_coords):
+        for every mesh axis, each coordinate group's stalest live
+        heartbeat age and lost-device list, plus ``suspect`` — per axis,
+        the group index holding the overall stalest (or a lost) device.
+        A tp group whose collective wedged shows up as ONE suspect
+        tensor index with every data index implicated symmetrically —
+        the signature that distinguishes a tp-leg fault from a dp
+        straggler."""
+        if not self.mesh_axes or not self.device_coords:
+            return None
+        now = time.monotonic()
+        axes = list(self.mesh_axes)
+        groups: dict = {a: {} for a in axes}
+        never = {a: set() for a in axes}
+        for d, coords in self.device_coords.items():
+            age = None
+            t = self.last_beat.get(d)
+            if t is not None and d not in self.lost:
+                age = round(now - t, 3)
+            for ai, a in enumerate(axes):
+                g = groups[a].setdefault(int(coords[ai]),
+                                         {"max_age_s": None, "lost": []})
+                if d in self.lost:
+                    g["lost"].append(d)
+                elif age is not None and (g["max_age_s"] is None
+                                          or age > g["max_age_s"]):
+                    g["max_age_s"] = age
+                elif age is None:
+                    # a live device that NEVER heartbeat is infinitely
+                    # stale, not infinitely fresh — score it as such so
+                    # a group wedged before its first completed step
+                    # cannot make a healthy, freshly-beaten group the
+                    # suspect (the report keeps max_age_s None: "no
+                    # observation", JSON-safe)
+                    never[a].add(int(coords[ai]))
+        suspect = {}
+        for a in axes:
+            scored = [(gi, (len(g["lost"]),
+                            float("inf") if gi in never[a]
+                            else g["max_age_s"] or 0.0))
+                      for gi, g in sorted(groups[a].items())]
+            if not scored:
+                continue
+            worst = max(scored, key=lambda x: x[1])
+            best = min(scored, key=lambda x: x[1])
+            # only name a suspect when the axis actually DIVERGES —
+            # identical ages on every group (the healthy whole-mesh
+            # barrier case) implicate nothing
+            if worst[1] > best[1]:
+                suspect[a] = worst[0]
+        return {"mesh_axes": dict(self.mesh_axes),
+                "groups": {a: {str(k): v for k, v in sorted(gs.items())}
+                           for a, gs in groups.items()},
+                "suspect": suspect}
+
     def report(self) -> dict:
-        """Straggler diagnostic: per-device heartbeat age + percentiles."""
+        """Straggler diagnostic: per-device heartbeat age + percentiles
+        (+ per-axis group attribution when the mesh decomposition is
+        configured)."""
         now = time.monotonic()
         ages = {d: (None if t is None else round(now - t, 3))
                 for d, t in self.last_beat.items()}
-        return {
+        out = {
             "step": self._armed_step,
             "timeout": self._current_timeout(),
             "device_heartbeat_age_s": ages,
             "lost_devices": sorted(self.lost),
             "step_duration_percentiles": self.step_percentiles(),
         }
+        ax = self.axis_report()
+        if ax is not None:
+            out["axis_groups"] = ax
+        return out
 
     @property
     def expired(self) -> bool:
@@ -323,9 +416,13 @@ def save_zero_checkpoint(ckpt_dir: str, state: Any, *, step: int,
     ``[n_shards]`` axis) go to per-shard files with per-shard CRC32
     digests; replicated leaves are stored once.  Thin veneer over
     :func:`apex_tpu.checkpoint.save_checkpoint` — all its knobs
-    (``blocking``, ``retry``, ``keep``, ...) pass through."""
+    (``blocking``, ``retry``, ``keep``, and the format-4 multi-axis
+    ``shard_axes=`` mapping, which supersedes ``shard_axis``) pass
+    through."""
     from apex_tpu import checkpoint as ckpt
 
+    if kw.get("shard_axes") is not None:
+        shard_axis = None  # multi-axis form supersedes the default axis
     return ckpt.save_checkpoint(ckpt_dir, state, step=step,
                                 shardings=shardings, shard_axis=shard_axis,
                                 **kw)
@@ -367,6 +464,39 @@ def largest_divisor_submesh(devices: Sequence, batch_size: int) -> list:
     return devices[:1]
 
 
+def best_surviving_submesh(survivors: Sequence, mesh_shape,
+                           *, batch_size: Optional[int] = None):
+    """Pick the best (dp, tp, pp) submesh fitting on the survivors — the
+    3-D generalization of :func:`largest_divisor_submesh` and the
+    default ``select_mesh`` policy of :func:`run_elastic_training`.
+
+    Per axis the candidate sizes are the divisors of the old size
+    (largest-divisor policy); the search prefers to **shrink dp before
+    tp before pp** — i.e. it keeps the pipeline depth if at all
+    possible (a pp change re-maps every stage's layer slices), then the
+    tensor width (a tp change re-slices every weight), and takes the
+    shrink out of the data axis, whose reshard is pure flat-buffer
+    re-partition.  ``batch_size`` additionally requires the chosen dp
+    to divide the global batch.  Returns ``(devices, (dp, tp, pp))`` —
+    the first dp·tp·pp survivors and the chosen shape."""
+    dp, tp, pp = (int(x) for x in mesh_shape)
+    survivors = list(survivors)
+    n = len(survivors)
+
+    def _divisors_desc(k):
+        return [d for d in range(k, 0, -1) if k % d == 0]
+
+    for pp_c in _divisors_desc(pp):
+        for tp_c in _divisors_desc(tp):
+            for dp_c in _divisors_desc(dp):
+                if dp_c * tp_c * pp_c > n:
+                    continue
+                if batch_size is not None and batch_size % dp_c:
+                    continue
+                return survivors[: dp_c * tp_c * pp_c], (dp_c, tp_c, pp_c)
+    return survivors[:1], (1, 1, 1)
+
+
 @dataclasses.dataclass
 class ElasticResult:
     """Outcome of :func:`run_elastic_training`."""
@@ -379,6 +509,7 @@ class ElasticResult:
     preempted: bool
     stop_reason: Optional[str]
     loop_results: list            # per-attempt LoopResult
+    mesh_shape: Optional[tuple] = None  # (dp, tp, pp) at exit (3-D runs)
 
 
 def run_elastic_training(
@@ -396,6 +527,9 @@ def run_elastic_training(
     max_restarts: int = 3,
     min_devices: int = 1,
     select_devices: Optional[Callable[[list], list]] = None,
+    mesh_shape: Optional[Sequence[int]] = None,
+    select_mesh: Optional[Callable] = None,
+    batch_size: Optional[int] = None,
     start_step: int = 0,
     on_step: Optional[Callable[[int], None]] = None,
     log_every: int = 0,
@@ -436,6 +570,22 @@ def run_elastic_training(
     (:func:`largest_divisor_submesh` is the standard policy); default
     uses every survivor.
 
+    **3-D meshes** (ISSUE 6): pass ``mesh_shape=(dp, tp, pp)``.  The
+    harness then calls ``build(devices, mesh_shape=shape)``, saves
+    *format-4* multi-axis sharded checkpoints (``shard_axes`` over the
+    full ``parallel_state`` mesh — shard files keyed by (d, p, t)
+    coordinates), and on device loss picks the best surviving 3-D
+    submesh via ``select_mesh(survivors, mesh_shape) -> (devices,
+    shape)`` (default :func:`best_surviving_submesh` with
+    ``batch_size`` — largest-divisor per axis, shrinking dp before tp
+    before pp) before rebuilding through ``parallel_state`` and
+    restoring the multi-axis shard set cross-topology.  A
+    ``select_devices`` filter still applies first: the mesh picker
+    chooses from the devices the filter allows.  ``device_loss``
+    / ``ckpt_restore`` telemetry and the bus mesh stamp then carry the
+    full ``mesh_axes`` decomposition, so post-recovery events are
+    attributable to the survivor submesh per axis.
+
     Gives up (re-raises) after ``max_restarts`` rebuilds or when fewer
     than ``min_devices`` survive.  Preemption/watchdog escalation
     behave exactly as in the inner loop: final blocking (sharded) save,
@@ -459,7 +609,24 @@ def run_elastic_training(
     lost: list = []
     restarts = 0
     loop_results: list = []
-    step_fn, state, shardings = build(devices)
+    shard_axes = None
+    if mesh_shape is not None:
+        mesh_shape = tuple(int(x) for x in mesh_shape)
+
+    def _shard_axes(shape):
+        dp, tp, pp = shape
+        # the parallel_state mesh order — and the stacking order of the
+        # flagship opt leaves ([dp, pp, tp, shard])
+        return {"data": dp, "pipeline": pp, "tensor": tp}
+
+    def _build(devs, shape):
+        if shape is None:
+            return build(devs)
+        return build(devs, mesh_shape=shape)
+
+    if mesh_shape is not None:
+        shard_axes = _shard_axes(mesh_shape)
+    step_fn, state, shardings = _build(devices, mesh_shape)
     step = start_step
 
     while True:
@@ -467,7 +634,9 @@ def run_elastic_training(
             result = run_resilient_training(
                 step_fn, state, batches[step - start_step:],
                 ckpt_dir=ckpt_dir, save_every=save_every, keep=keep,
-                shardings=shardings, shard_axis=shard_axis,
+                shardings=shardings,
+                shard_axis=None if shard_axes else shard_axis,
+                shard_axes=shard_axes,
                 handler=handler, guard=guard, watchdog=watchdog,
                 start_step=step, on_step=on_step,
                 log_every=log_every, log_fn=log_fn,
@@ -477,25 +646,41 @@ def run_elastic_training(
                 state=result.state, step=result.step, restarts=restarts,
                 devices=devices, lost_devices=lost,
                 preempted=result.preempted,
-                stop_reason=result.stop_reason, loop_results=loop_results)
+                stop_reason=result.stop_reason, loop_results=loop_results,
+                mesh_shape=mesh_shape)
         except DeviceLossError as e:
             lost_ids = set(e.device_ids)
             lost.extend(sorted(lost_ids))
             survivors = [d for d in devices
                          if getattr(d, "id", d) not in lost_ids]
-            if select_devices is not None:
+            new_shape = mesh_shape
+            if mesh_shape is not None:
+                if select_devices is not None:
+                    # a device-filter policy (exclude known-bad hosts)
+                    # composes with the mesh picker: filter the pool
+                    # first, then choose the submesh from what the
+                    # policy allows — never silently drop the filter
+                    survivors = list(select_devices(survivors))
+                picker = select_mesh or (
+                    lambda s, shape: best_surviving_submesh(
+                        s, shape, batch_size=batch_size))
+                survivors, new_shape = picker(survivors, mesh_shape)
+                survivors = list(survivors)
+            elif select_devices is not None:
                 survivors = list(select_devices(survivors))
             restarts += 1
             if telemetry is not None:
                 # no step stamp: the loss surfaced as an exception, so
                 # the exact faulting step lives in the inner loop's
                 # postmortem (already flushed), not here
-                telemetry.emit(
-                    "device_loss",
+                ev = dict(
                     device_ids=sorted(lost_ids),
                     survivors=len(survivors), restarts=restarts,
                     recoverable=(restarts <= max_restarts
                                  and len(survivors) >= max(1, min_devices)))
+                if new_shape is not None:
+                    ev["mesh_axes"] = _shard_axes(new_shape)
+                telemetry.emit("device_loss", **ev)
             if restarts > max_restarts:
                 raise
             if len(survivors) < max(1, min_devices):
@@ -506,19 +691,27 @@ def run_elastic_training(
             if watchdog is not None:
                 watchdog.mark_lost(lost_ids)
             devices = survivors
+            mesh_shape = new_shape
+            if mesh_shape is not None:
+                shard_axes = _shard_axes(mesh_shape)
             emit(f"[elastic] lost device(s) {sorted(lost_ids)} — "
-                 f"rebuilding on {len(devices)} survivors "
-                 f"(restart {restarts}/{max_restarts})")
+                 f"rebuilding on {len(devices)} survivors"
+                 + (f" as (dp, tp, pp)={mesh_shape}"
+                    if mesh_shape is not None else "")
+                 + f" (restart {restarts}/{max_restarts})")
             t_rebuild = time.monotonic()
-            step_fn, state, shardings = build(devices)
+            step_fn, state, shardings = _build(devices, mesh_shape)
             if telemetry is not None:
                 telemetry.accountant().pause(
                     time.monotonic() - t_rebuild, "rebuild")
-                telemetry.set_mesh({
+                stamp = {
                     "n_devices": len(devices),
                     "platform": getattr(devices[0], "platform", "unknown")
                     if devices else "none",
-                    "lost_devices": sorted(lost)})
+                    "lost_devices": sorted(lost)}
+                if mesh_shape is not None:
+                    stamp["mesh_axes"] = _shard_axes(mesh_shape)
+                telemetry.set_mesh(stamp)
             if _complete_steps(ckpt_dir):
                 t_restore = time.monotonic()
                 state, step = restore_zero_checkpoint(ckpt_dir, state)

@@ -36,6 +36,10 @@ DATA_AXIS = "data"
 PIPELINE_AXIS = "pipeline"
 TENSOR_AXIS = "tensor"
 
+#: Mesh-order axis tuple — the coordinate order of format-4 sharded
+#: checkpoints and the linearized-world ZeRO layout.
+MESH_AXES = (DATA_AXIS, PIPELINE_AXIS, TENSOR_AXIS)
+
 
 @dataclasses.dataclass
 class _ParallelState:
@@ -127,6 +131,17 @@ def get_data_parallel_world_size() -> int:
 
 def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
     return _state().virtual_pipeline_model_parallel_size
+
+
+def mesh_axis_sizes() -> dict:
+    """Ordered ``{axis name: size}`` of the registered mesh in
+    :data:`MESH_AXES` order — the ``shard_axes`` mapping a format-4
+    sharded save (:func:`apex_tpu.checkpoint.save_checkpoint`) and the
+    telemetry mesh stamp want."""
+    st = _state()
+    return {DATA_AXIS: st.data_parallel_size,
+            PIPELINE_AXIS: st.pipeline_model_parallel_size,
+            TENSOR_AXIS: st.tensor_model_parallel_size}
 
 
 # --- axis names (the "groups") ---------------------------------------------

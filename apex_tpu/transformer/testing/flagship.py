@@ -173,8 +173,12 @@ class FlagshipSetup(NamedTuple):
     # structure-prefix PartitionSpecs for the (params, opt_state) state
     # tuple: params replicated, every opt_state leaf led by the "data"
     # axis — exactly what save_checkpoint(shard_axis="data") needs to
-    # write per-rank partition files (resilience/elastic.py)
+    # write per-rank partition files (resilience/elastic.py).  On a 3-D
+    # mesh the opt_state spec leads with all three axes and mesh_axes
+    # carries the {"data": dp, "pipeline": pp, "tensor": tp} mapping a
+    # format-4 save (shard_axes=) wants.
     shardings: Any = None
+    mesh_axes: Any = None
 
 
 def build_flagship_train_step(
@@ -186,6 +190,7 @@ def build_flagship_train_step(
     devices: Optional[Sequence] = None,
     donate: bool = True,
     seed: int = 0,
+    mesh_shape: Optional[Sequence[int]] = None,
 ) -> FlagshipSetup:
     """One flagship construction: model + ZeRO-sharded FusedAdam over
     the "data" axis of a fresh ``parallel_state`` mesh spanning
@@ -194,13 +199,37 @@ def build_flagship_train_step(
 
     The returned ``step(params, opt_state, tokens, labels)`` expects the
     GLOBAL batch (sharded over "data" internally; batch must divide the
-    device count) and returns ``(params, opt_state, loss)`` with params
-    bitwise-replicated across ranks.  ``donate=True`` donates params and
-    optimizer state — at 1.3B the old buffers ARE the fit margin.
+    data-parallel size) and returns ``(params, opt_state, loss)`` with
+    params bitwise-replicated across ranks.  ``donate=True`` donates
+    params and optimizer state — at 1.3B the old buffers ARE the fit
+    margin.
+
+    ``mesh_shape=(dp, tp, pp)`` — multi-axis form (ISSUE 6): the mesh
+    carries all three ``parallel_state`` axes, tensor parallelism
+    shards the *compute* (each device runs its tp-rank's slice of the
+    replicated master params, taken with a traced ``dynamic_slice``
+    inside the step), and ZeRO shards the optimizer state over the
+    **linearized world** — every (d, p, t) coordinate owns one
+    contiguous shard of the master flat buffer, so the opt_state leaves
+    are ``[dp, pp, tp, shard]`` stacks with spec
+    ``P("data", "pipeline", "tensor")``.  The grad is taken *through*
+    the ``shard_map`` boundary (``value_and_grad`` of the sharded loss
+    closure), so it arrives as the fully replicated global master grad
+    on every device; the optimizer's mesh-wide ``psum_scatter`` then
+    sums ``world`` identical copies and its ``grad_average`` divides
+    them back out — exact for power-of-two worlds, with no per-axis
+    masking or dp-only averaging.  ``pp`` must be 1 for the *train
+    step* (pipeline schedules stay in the dryrun legs; the checkpoint /
+    reshard machinery handles pp > 1 states).  ``mesh_shape=None``
+    keeps the historical single-axis layout byte-for-byte.
     """
     if isinstance(plan, str):
         plan = FIT_PLANS[plan]
     devs = list(devices if devices is not None else jax.devices())
+    if mesh_shape is not None:
+        return _build_flagship_train_step_3d(
+            cfg, plan=plan, lr=lr, weight_decay=weight_decay, devs=devs,
+            donate=donate, seed=seed, mesh_shape=tuple(mesh_shape))
     parallel_state.destroy_model_parallel()
     mesh = parallel_state.initialize_model_parallel(1, 1, devices=devs)
     n_shards = len(devs)
@@ -247,6 +276,134 @@ def build_flagship_train_step(
                          model, plan, shardings=(P(), P("data")))
 
 
+def _tp_slice_tables(master, local0):
+    """Static per-leaf (dim, size) tables for the traced tp slice:
+    compare master leaf shapes against tp-rank-0's ``shard_master``
+    output — equal shape means replicated (sentinel dim -1); otherwise
+    exactly one dim shrinks, and rank r's slice starts at ``r * size``
+    along it (the contiguous-equal-chunk contract every
+    ``tensor_parallel`` layer's ``shard_master`` follows)."""
+    def _dim(m, l):
+        if m.shape == l.shape:
+            return -1
+        if m.ndim != l.ndim:
+            raise ValueError(
+                f"shard_master changed rank: {m.shape} -> {l.shape}")
+        diffs = [i for i, (a, b) in enumerate(zip(m.shape, l.shape))
+                 if a != b]
+        if len(diffs) != 1:
+            raise ValueError(
+                f"shard_master slices more than one dim: {m.shape} -> "
+                f"{l.shape} — the traced tp slice cannot express this")
+        return diffs[0]
+
+    dims = jax.tree_util.tree_map(_dim, master, local0)
+    sizes = jax.tree_util.tree_map(
+        lambda l, d: int(l.shape[d]) if d >= 0 else 0, local0, dims)
+    return dims, sizes
+
+
+def _build_flagship_train_step_3d(cfg, *, plan, lr, weight_decay, devs,
+                                  donate, seed, mesh_shape):
+    """The mesh_shape=(dp, tp, pp) body of
+    :func:`build_flagship_train_step` (see its docstring for the
+    layout contract)."""
+    dp, tp, pp = (int(x) for x in mesh_shape)
+    if pp != 1:
+        raise NotImplementedError(
+            "the 3-D flagship train step supports pp=1 (pipeline "
+            "schedules live in the dryrun legs); checkpoint/reshard "
+            "machinery handles pp > 1 states")
+    world = dp * tp * pp
+    if world != len(devs):
+        raise ValueError(
+            f"mesh_shape {mesh_shape} needs {world} devices, got "
+            f"{len(devs)}")
+    if cfg.num_attention_heads % tp or cfg.hidden_size % tp \
+            or cfg.vocab_size % tp:
+        raise ValueError(
+            f"tp={tp} must divide heads/hidden/vocab "
+            f"({cfg.num_attention_heads}/{cfg.hidden_size}/"
+            f"{cfg.vocab_size})")
+    parallel_state.destroy_model_parallel()
+    mesh = parallel_state.initialize_model_parallel(tp, pp, devices=devs)
+
+    cfg_tp = dataclasses.replace(cfg, tp_size=tp)
+    model = GPTModel(cfg_tp)
+    master = jax.tree_util.tree_map(
+        lambda a: a.astype(plan.param_dtype),
+        model.init_master(jax.random.PRNGKey(seed)))
+    local0 = model.shard_master(master, 0)
+    slice_dims, slice_sizes = _tp_slice_tables(master, local0)
+
+    def _slice_tp(mp, t_idx):
+        return jax.tree_util.tree_map(
+            lambda m, d, n: m if d < 0 else jax.lax.dynamic_slice_in_dim(
+                m, t_idx * n, n, axis=d),
+            mp, slice_dims, slice_sizes)
+
+    opt = DistributedFusedAdam(
+        lr=lr, weight_decay=weight_decay,
+        scatter_dtype=plan.scatter_dtype,
+        gather_dtype=plan.gather_dtype,
+        exp_avg_dtype=plan.exp_avg_dtype,
+        axis_name=tuple(parallel_state.MESH_AXES))
+    schema = opt.make_schema(master, world)
+    state0 = opt.init(master, schema, world)
+    opt_state = jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a[None, None, None],
+                                   (dp, pp, tp, *a.shape)), state0)
+
+    # The grad is taken OUTSIDE the shard_map.  Inside a
+    # check_rep=False region jax transposes ``psum`` to ``psum``
+    # (the unreplicated-cotangent convention), so differentiating
+    # through the model's tensor-parallel reductions *inside* the
+    # region scales cotangents by the axis size — loss comes out right
+    # and every grad is ×tp (measured, exactly).  Differentiating
+    # through the shard_map boundary instead uses its true adjoints
+    # end-to-end — the convention tensor_parallel/mappings.py documents
+    # and tests/L0/test_tensor_parallel.py's col→row grad-parity case
+    # pins.  The outer grads arrive replicated (the global master
+    # grad), so the opt step needs no data-average: the mesh-wide
+    # psum_scatter sums world identical copies and grad_average
+    # divides them back out (exact for power-of-two worlds).
+    def inner_fwd(mp, tokens, labels):
+        t_idx = jax.lax.axis_index(parallel_state.TENSOR_AXIS)
+        loss = jnp.mean(model.apply(_slice_tp(mp, t_idx), tokens,
+                                    labels=labels))
+        return jax.lax.pmean(loss, parallel_state.DATA_AXIS)
+
+    loss_fn = shard_map(
+        inner_fwd, mesh=mesh,
+        in_specs=(P(), P("data"), P("data")), out_specs=P(),
+        check_rep=False)
+
+    def inner_opt(grads, state, mp):
+        state = jax.tree_util.tree_map(lambda a: a[0, 0, 0], state)
+        new_p, new_state = opt.step(grads, state, mp, schema)
+        return new_p, jax.tree_util.tree_map(
+            lambda a: a[None, None, None], new_state)
+
+    spec3 = P(*parallel_state.MESH_AXES)
+    opt_sharded = shard_map(
+        inner_opt, mesh=mesh,
+        in_specs=(P(), spec3, P()), out_specs=(P(), spec3),
+        check_rep=False)
+
+    def train_step(mp, state, tokens, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(mp, tokens, labels)
+        new_p, new_state = opt_sharded(grads, state, mp)
+        return new_p, new_state, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
+    return FlagshipSetup(
+        step, master, opt_state, mesh, schema, opt, model, plan,
+        shardings=(P(), spec3),
+        mesh_axes={parallel_state.DATA_AXIS: dp,
+                   parallel_state.PIPELINE_AXIS: pp,
+                   parallel_state.TENSOR_AXIS: tp})
+
+
 def flagship_elastic_build(cfg: GPTConfig, *, plan: str | ZeroFitPlan
                            = "bf16_fit", lr: float = 1e-4,
                            seed: int = 0, donate: bool = False,
@@ -259,12 +416,19 @@ def flagship_elastic_build(cfg: GPTConfig, *, plan: str | ZeroFitPlan
     ``[len(devices)]`` shard axis on every opt leaf, so it doubles as
     the cross-topology restore target) and ``step_fn(state, (tokens,
     labels))`` returns ``(state, None)``.  ``on_loss(step_loss)`` taps
-    the per-step loss for trajectory assertions."""
+    the per-step loss for trajectory assertions.
 
-    def build(devices):
+    ``build(devices, mesh_shape=(dp, tp, pp))`` — the multi-axis form
+    the 3-D elastic harness calls: the step builds over the full
+    dp×tp×pp ``parallel_state`` mesh and the opt leaves carry
+    ``[dp, pp, tp, shard]`` stacks (see
+    :func:`build_flagship_train_step`'s ``mesh_shape`` notes)."""
+
+    def build(devices, mesh_shape=None):
         fs = build_flagship_train_step(cfg, plan=plan, lr=lr,
                                        devices=list(devices), seed=seed,
-                                       donate=donate)
+                                       donate=donate,
+                                       mesh_shape=mesh_shape)
 
         def step_fn(state, batch):
             p, s = state

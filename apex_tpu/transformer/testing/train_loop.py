@@ -73,6 +73,7 @@ def run_resilient_training(
     async_saves: bool = True,
     shardings: Any = None,
     shard_axis: Optional[str] = None,
+    shard_axes: Optional[Any] = None,
     handler: Optional[GracePeriodHandler] = None,
     guard: Optional[StepGuard] = None,
     watchdog: Any = None,
@@ -89,6 +90,9 @@ def run_resilient_training(
       keeps stepping while the write is in flight; the next save fences);
       ``shard_axis`` makes every save *sharded* (per-rank partition files
       for leaves whose spec leads with that axis — the ZeRO layout);
+      ``shard_axes`` (an ordered {mesh axis: size} mapping) makes them
+      *multi-axis* sharded — format 4, shard files keyed by (d, p, t)
+      mesh coordinates (the 3-D elastic harness's save path);
     - after every step: poll ``handler.should_stop``; on preemption write a
       final BLOCKING checkpoint (itself fencing any in-flight async write)
       and return with ``preempted=True`` — the caller restarts later via
@@ -142,7 +146,7 @@ def run_resilient_training(
         telemetry.emit(
             "run_start", step=start_step,
             save_every=save_every, async_saves=bool(async_saves),
-            sharded=shard_axis is not None,
+            sharded=shard_axis is not None or shard_axes is not None,
             watchdog=watchdog is not None, guarded=guard is not None)
 
     def _save(blocking: bool) -> None:
@@ -152,6 +156,7 @@ def run_resilient_training(
         t0 = time.monotonic()
         ckpt.save_checkpoint(ckpt_dir, state, step=step, keep=keep,
                              shardings=shardings, shard_axis=shard_axis,
+                             shard_axes=shard_axes,
                              blocking=blocking or not async_saves)
         dt = time.monotonic() - t0
         last_saved = step

@@ -58,6 +58,7 @@ class TestFlashAttention:
             flash_attention(q, k, v, causal=True), _naive(q, k, v, True),
             rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow  # heaviest interpret/parity tier (ISSUE 6 wall-clock)
     def test_packed_qkv_matches_naive(self):
         # the r5 transpose-free entry point: [b, s, nh*(q|k|v)] in the
         # Megatron interleaved projection layout -> context [b, s, h].
@@ -203,6 +204,7 @@ class TestFlashAttention:
         # 128-multiple block with several q-blocks — allowed
         assert ok(sd(512), sd(512), None, 128, 128)
 
+    @pytest.mark.slow  # heaviest interpret/parity tier (ISSUE 6 wall-clock)
     def test_causal_sq_longer_than_sk(self):
         # causal cross-attention with sq > sk: the leading q rows attend
         # to nothing (fully masked) — the unrolled-tiles kernels must
@@ -275,6 +277,7 @@ class TestFlashAttention:
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-4)
 
+    @pytest.mark.slow  # heaviest interpret/parity tier (ISSUE 6 wall-clock)
     def test_pallas_interpret_path_matches(self):
         # exercise the Pallas kernel in interpret mode explicitly
         from apex_tpu.ops.attention import _flash_fwd_pallas
@@ -413,6 +416,34 @@ class TestVarlenFastPath:
         # gate failure (unaligned seq) falls back to the generic path
         assert route(8, 1000, 16, 64, has_segments=True) == "generic"
 
+    def test_qkv_gate_prices_caller_dtype(self, monkeypatch):
+        """ADVICE r5 #1 / ROADMAP maintenance regression pin: the
+        packed-QKV VMEM gate must price the CALLER's qkv itemsize, not
+        a hardcoded bf16.  At the flagship d=128/s=2048 shape the
+        resident set is ~11 MB in bf16 (fits the 12 MB budget at the
+        auto-shrunk block 256) and ~2x that in fp32 — a near-budget
+        fp32 qkv must route to the generic fallback instead of passing
+        the gate and failing Mosaic VMEM allocation."""
+        import jax.numpy as jnp
+
+        attn_mod = self._tpu(monkeypatch)
+        gate = attn_mod._qkv_packed_ok
+        assert gate(8, 2048, 16, 128, 256, True, 0.0, jnp.bfloat16)
+        assert not gate(8, 2048, 16, 128, 256, True, 0.0, jnp.float32)
+        route = attn_mod.flash_attention_qkv_route
+        assert route(8, 2048, 16, 128, block=256,
+                     dtype=jnp.bfloat16) == "packed"
+        assert route(8, 2048, 16, 128, block=256,
+                     dtype=jnp.float32) == "generic"
+        # the public wrapper threads the real qkv.dtype into the gate:
+        # tracing an fp32 qkv takes the generic (transposed) path, whose
+        # jaxpr transposes the heads — the packed kernel's does not
+        qkv32 = jnp.zeros((1, 2048, 16 * 3 * 128), jnp.float32)
+        jaxpr = str(jax.make_jaxpr(
+            lambda x: attn_mod.flash_attention_qkv(x, 16, block=256))(
+                qkv32))
+        assert "transpose" in jaxpr
+
     def test_routing_override_forces_generic(self, monkeypatch):
         attn_mod = self._tpu(monkeypatch)
         sd = jax.ShapeDtypeStruct((8, 512, 64), jnp.bfloat16)
@@ -531,6 +562,7 @@ class TestVarlenFastPath:
         dref = jax.grad(lambda x: jnp.sum(ref(x) * dctx))(qkv)
         np.testing.assert_allclose(dqkv, dref, rtol=1e-3, atol=1e-4)
 
+    @pytest.mark.slow  # heaviest interpret/parity tier (ISSUE 6 wall-clock)
     def test_qkv_wrapper_segments_fallback_matches(self):
         """Public flash_attention_qkv(segment_ids=...) — off-TPU this
         takes the generic fallback with identical math; grads flow."""
@@ -664,6 +696,7 @@ class TestRingAttention:
         np.testing.assert_allclose(out, _naive(q, k, v, causal=True),
                                    rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow  # heaviest 8-device ring bwd (ISSUE 6 wall-clock)
     def test_grads_flow_through_ring(self, mesh):
         q = jax.random.normal(jax.random.PRNGKey(0), (1, 32, 8))
         k = jax.random.normal(jax.random.PRNGKey(1), (1, 32, 8))
@@ -766,6 +799,7 @@ class TestMultiheadAttnModules:
         assert not np.allclose(o1, o2)
 
 
+@pytest.mark.slow  # heaviest interpret/parity tier (ISSUE 6 wall-clock)
 def test_trainable_mask_bias_gets_gradient():
     """mask_is_constant=False must produce a real (nonzero) bias gradient
     (ADVICE r2: the default path silently returns zeros for it)."""
@@ -833,6 +867,7 @@ class TestKernelDropout:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow  # heaviest interpret/parity tier (ISSUE 6 wall-clock)
     def test_grads_match_dense_reference(self):
         from apex_tpu.ops.attention import (_dropout_keep_full,
                                             flash_attention)

@@ -128,6 +128,7 @@ class TestDistributedFusedAdam:
         p, state = train_50(params, state)
         assert dist(p) < d0 * 0.2
 
+    @pytest.mark.slow  # heaviest dtype-plan parity case (ISSUE 6 wall-clock)
     def test_dtype_plan_close_to_fp32(self, mesh):
         """The r6 memory-fit knobs (bf16 scatter/gather transport + bf16
         momentum storage — the gpt1p3b bf16_fit plan): update math stays

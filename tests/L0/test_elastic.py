@@ -237,10 +237,13 @@ def test_watchdog_timeout_escalates_to_grace_handler(chaos_ckpt_dir):
     save-and-exit path — the loop writes a final checkpoint and returns
     preempted with the watchdog's reason."""
     state = {"w": jnp.ones((4,))}
+    # generous margins: under full-suite load a NORMAL step can take
+    # hundreds of ms, and a deadline racing that fires at the wrong
+    # step (observed flake at timeout=0.25/delay=0.6)
     slow = chaos.slow_collective(lambda s, b: ({"w": s["w"] + 1.0}, None),
-                                 at_step=3, delay=0.6)
+                                 at_step=3, delay=2.5)
     h = res.GracePeriodHandler()
-    with res.Watchdog(timeout=0.25, handler=h, poll_interval=0.02) as wd:
+    with res.Watchdog(timeout=1.0, handler=h, poll_interval=0.02) as wd:
         result = run_resilient_training(
             slow, state, [None] * 6, ckpt_dir=str(chaos_ckpt_dir),
             save_every=2, handler=h, watchdog=wd)
@@ -255,7 +258,7 @@ def test_watchdog_timeout_escalates_to_grace_handler(chaos_ckpt_dir):
             getattr(d, "id", d) for d in jax.devices()}
         pct = report["step_duration_percentiles"]
         assert set(pct) >= {"p50", "p90", "p99", "max"}
-        assert pct["max"] < 0.6  # history holds the FAST steps only
+        assert pct["max"] < 2.5  # history holds the FAST steps only
     assert ckpt.latest_step(str(chaos_ckpt_dir)) == 3
 
 
@@ -459,6 +462,434 @@ def test_device_loss_resumes_on_submesh_with_golden_trajectory(tmp_path):
     # resumed-on-submesh steps reproduce the golden trajectory from the
     # restored step: bf16 compute quantizes away the reduction-order
     # difference of the shrunken data axis — ≤ 1 bf16 ulp, 0 in practice
+    for got, want in zip(losses[3:], golden[2:]):
+        assert _bf16_ulp_diff(np.float32(got), np.float32(want)) <= 1, (
+            losses, golden)
+
+
+# ----------------------------------------- multi-axis (3-D) resilience
+
+
+def _synthetic_state_3d(lead=(4, 1, 2), shard=32, seed=0):
+    """A 3-D-flagship-shaped state without the model: replicated params,
+    opt partitions stacked ``[dp, pp, tp, shard]`` over the linearized
+    world, broadcast step counter stacked per coordinate."""
+    rng = np.random.RandomState(seed)
+    params = {"w": jnp.asarray(rng.randn(16), jnp.float32)}
+    opt = {
+        "step": jnp.broadcast_to(jnp.asarray(5, jnp.int32), lead),
+        "exp_avg": jnp.asarray(rng.randn(*lead, shard), jnp.float32),
+        "exp_avg_sq": jnp.asarray(
+            np.abs(rng.randn(*lead, shard)), jnp.float32),
+    }
+    shardings = (P(), P("data", "pipeline", "tensor"))
+    axes = {"data": lead[0], "pipeline": lead[1], "tensor": lead[2]}
+    return (params, opt), shardings, axes
+
+
+def _target_3d(lead, shard):
+    return ({"w": jnp.zeros(16, jnp.float32)},
+            {"step": jnp.zeros(lead, jnp.int32),
+             "exp_avg": jnp.zeros((*lead, shard), jnp.float32),
+             "exp_avg_sq": jnp.zeros((*lead, shard), jnp.float32)})
+
+
+def test_format4_manifest_and_shard_files(chaos_ckpt_dir):
+    """The format-4 contract (docs/resilience.md "3D topologies"):
+    shard files keyed by (d, p, t) mesh coordinates, per-coordinate
+    CRC32 digests, a mesh_axes topology record, replicated leaves
+    stored once."""
+    import json
+
+    state, shardings, axes = _synthetic_state_3d((4, 1, 2))
+    ckpt.save_checkpoint(str(chaos_ckpt_dir), state, step=1,
+                         shardings=shardings, shard_axes=axes)
+    d = ckpt.step_dir(str(chaos_ckpt_dir), 1)
+    names = sorted(os.listdir(d))
+    assert "arrays.npz" in names  # the replicated params
+    want = [ckpt.shard_file_coords((dd, 0, t))
+            for dd in range(4) for t in range(2)]
+    assert all(w in names for w in want)
+    with open(os.path.join(d, "manifest.json")) as f:
+        man = json.load(f)
+    assert man["format"] == 4
+    assert man["topology"]["mesh_axes"] == {
+        "data": 4, "pipeline": 1, "tensor": 2}
+    opt_entries = {k: e for k, e in man["leaves"].items()
+                   if e.get("shard_axes")}
+    assert len(opt_entries) == 3
+    for e in opt_entries.values():
+        assert e["shard_axes"] == ["data", "pipeline", "tensor"]
+        assert len(e["crc32_shards"]) == 8  # one digest per coordinate
+    step_e = next(e for k, e in opt_entries.items() if "step" in k)
+    assert step_e["replicated_shards"] is True
+    assert ckpt.verify_checkpoint(str(chaos_ckpt_dir), 1) == 1
+
+
+def test_garbled_mesh_axes_manifest_is_corruption(chaos_ckpt_dir):
+    """A valid-JSON manifest whose topology lost mesh_axes (bit rot /
+    partial overwrite) must surface as CheckpointCorruptionError under
+    verify — not a raw KeyError — so restore_resilient's fallback walk
+    can condemn the step and move to an older intact checkpoint."""
+    import json
+
+    state, shardings, axes = _synthetic_state_3d((4, 1, 2))
+    ckpt.save_checkpoint(str(chaos_ckpt_dir), state, step=1,
+                         shardings=shardings, shard_axes=axes)
+    mpath = os.path.join(ckpt.step_dir(str(chaos_ckpt_dir), 1),
+                         "manifest.json")
+    with open(mpath) as f:
+        man = json.load(f)
+    man["topology"]["mesh_axes_corrupt"] = man["topology"].pop("mesh_axes")
+    with open(mpath, "w") as f:
+        json.dump(man, f)
+    with pytest.raises(ckpt.CheckpointCorruptionError):
+        ckpt.restore_checkpoint(str(chaos_ckpt_dir), state, verify=True)
+
+
+def _schema_total(raw: int, world: int) -> int:
+    """total_multiple_of = 128·world, as the real flat schema pads."""
+    m = 128 * world
+    return (raw + m - 1) // m * m
+
+
+@pytest.mark.parametrize("src,dst", [
+    ((4, 1, 2), (2, 2, 2)),
+    ((2, 2, 2), (8, 1, 1)),
+    ((8, 1, 1), (1, 1, 1)),
+    ((4, 1, 2), (1, 1, 1)),
+    ((1, 1, 1), (4, 2, 1)),
+    ((2, 2, 2), (4, 2, 1)),
+])
+def test_format4_reshard_sweep_bitwise(chaos_ckpt_dir, src, dst):
+    """Property-style (dp, pp, tp) reshape sweep: restored optimizer
+    state is fp32-BITWISE equal to the source's logical flat buffer for
+    any N→M reshape of the mesh, the broadcast counter re-broadcasts,
+    and schema tail padding grows/trims exactly — modelled on the real
+    flat schema (raw content + zeros to 128·world)."""
+    raw = 1500
+    rng = np.random.RandomState(7)
+    buf = rng.randn(raw).astype(np.float32)
+    world_s, world_d = int(np.prod(src)), int(np.prod(dst))
+    total_s = _schema_total(raw, world_s)
+    total_d = _schema_total(raw, world_d)
+
+    def _stacked(lead, total):
+        world = int(np.prod(lead))
+        flat = np.zeros((total,), np.float32)
+        flat[:raw] = buf
+        return jnp.asarray(flat.reshape(*lead, total // world))
+
+    state = ({"w": jnp.asarray(buf[:16])},
+             {"step": jnp.broadcast_to(jnp.asarray(5, jnp.int32), src),
+              "exp_avg": _stacked(src, total_s),
+              "exp_avg_sq": _stacked(src, total_s)})
+    shardings = (P(), P("data", "pipeline", "tensor"))
+    axes = {"data": src[0], "pipeline": src[1], "tensor": src[2]}
+    ckpt.save_checkpoint(str(chaos_ckpt_dir), state, step=1,
+                         shardings=shardings, shard_axes=axes)
+    target = _target_3d(dst, total_d // world_d)
+    (p, o), step = res.restore_resilient(str(chaos_ckpt_dir), target)
+    assert step == 1
+    assert np.all(np.asarray(o["step"]) == 5)
+    assert o["step"].shape == tuple(dst)
+    for leaf in ("exp_avg", "exp_avg_sq"):
+        got = np.asarray(o[leaf]).reshape(-1)
+        np.testing.assert_array_equal(got[:raw], buf)  # fp32 bitwise
+        assert np.all(got[raw:] == 0)
+
+
+def test_format4_roundtrip_8_to_222_to_8(chaos_ckpt_dir):
+    """The ISSUE 6 round-trip: (8,1,1) → (2,2,2) → (8,1,1) restores the
+    optimizer state fp32-bitwise."""
+    state, shardings, axes = _synthetic_state_3d((8, 1, 1), 32)
+    d1 = str(chaos_ckpt_dir / "a")
+    d2 = str(chaos_ckpt_dir / "b")
+    ckpt.save_checkpoint(d1, state, step=1, shardings=shardings,
+                         shard_axes=axes)
+    mid, _ = res.restore_resilient(d1, _target_3d((2, 2, 2), 32))
+    ckpt.save_checkpoint(d2, mid, step=1, shardings=shardings,
+                         shard_axes={"data": 2, "pipeline": 2,
+                                     "tensor": 2})
+    (p, o), _ = res.restore_resilient(d2, _target_3d((8, 1, 1), 32))
+    for leaf in ("exp_avg", "exp_avg_sq"):
+        np.testing.assert_array_equal(np.asarray(o[leaf]),
+                                      np.asarray(state[1][leaf]))
+    assert np.all(np.asarray(o["step"]) == 5)
+
+
+def test_format4_pp_stage_remap_of_layer_slices(chaos_ckpt_dir):
+    """A pp-stacked layer-slice leaf ([pp, L/pp, h], spec leading with
+    "pipeline") re-maps its layer slices exactly across a pp change —
+    the C-order flatten contract makes stage boundaries land on layer
+    boundaries."""
+    rng = np.random.RandomState(3)
+    layers = jnp.asarray(rng.randn(8, 16), jnp.float32)  # L=8 logical
+    state = {"stages": layers.reshape(2, 4, 16)}         # pp=2
+    shardings = {"stages": P("pipeline")}
+    ckpt.save_checkpoint(str(chaos_ckpt_dir), state, step=1,
+                         shardings=shardings,
+                         shard_axes={"data": 1, "pipeline": 2,
+                                     "tensor": 1})
+    out, _ = ckpt.restore_checkpoint(
+        str(chaos_ckpt_dir), {"stages": jnp.zeros((4, 2, 16))})
+    np.testing.assert_array_equal(
+        np.asarray(out["stages"]).reshape(8, 16), np.asarray(layers))
+    # and down to the pp=1 debug restore
+    out1, _ = ckpt.restore_checkpoint(
+        str(chaos_ckpt_dir), {"stages": jnp.zeros((1, 8, 16))})
+    np.testing.assert_array_equal(
+        np.asarray(out1["stages"]).reshape(8, 16), np.asarray(layers))
+
+
+def test_format3_restores_byte_identical_through_new_path(chaos_ckpt_dir):
+    """Format-3 ("data"-axis) checkpoints keep restoring BYTE-identically
+    through the format-4-capable path (ISSUE 6 acceptance), including
+    into a 3-D-shaped target (the migration direction)."""
+    state, shardings = _synthetic_state(8, 32)
+    ckpt.save_checkpoint(str(chaos_ckpt_dir), state, step=1,
+                         shardings=shardings, shard_axis="data")
+    # byte-identical same-topology restore
+    (p, o), _ = res.restore_resilient(str(chaos_ckpt_dir),
+                                      _synthetic_state(8, 32)[0])
+    for k in ("step", "exp_avg", "exp_avg_sq"):
+        np.testing.assert_array_equal(np.asarray(o[k]),
+                                      np.asarray(state[1][k]))
+    # format-3 → 3-D target: the dp stack linearizes into the
+    # (dp', pp', tp') world exactly (migration note, docs/resilience.md)
+    target = _target_3d((2, 1, 2), 64)
+    (_, o3), _ = res.restore_resilient(str(chaos_ckpt_dir), target)
+    for k in ("exp_avg", "exp_avg_sq"):
+        np.testing.assert_array_equal(
+            np.asarray(o3[k]).reshape(-1),
+            np.asarray(state[1][k]).reshape(-1))
+    assert np.all(np.asarray(o3["step"]) == 5)
+
+
+def test_best_surviving_submesh_policy():
+    """Largest-divisor per axis, shrinking dp before tp before pp; dp
+    additionally divides the global batch."""
+    devs = list(range(8))
+    # lose 2 of (4, 2, 1): dp shrinks 4→2, tp/pp untouched
+    assert res.best_surviving_submesh(devs[:6], (4, 2, 1)) == (
+        devs[:4], (2, 2, 1))
+    # batch divisibility caps dp
+    assert res.best_surviving_submesh(devs[:6], (4, 2, 1),
+                                      batch_size=6) == (devs[:4],
+                                                        (2, 2, 1))
+    assert res.best_surviving_submesh(devs[:6], (4, 2, 1),
+                                      batch_size=9) == (devs[:2],
+                                                        (1, 2, 1))
+    # tp shrinks only after dp is exhausted
+    assert res.best_surviving_submesh(devs[:1], (4, 2, 1)) == (
+        devs[:1], (1, 1, 1))
+    assert res.best_surviving_submesh(devs[:3], (2, 4, 1)) == (
+        devs[:2], (1, 2, 1))
+    # pp survives while tp gives way: (1, 4, 2) on 7 survivors
+    assert res.best_surviving_submesh(devs[:7], (1, 4, 2)) == (
+        devs[:4], (1, 2, 2))
+
+
+def test_watchdog_per_axis_attribution():
+    """A stalled tp group shows up as the suspect tensor index: every
+    device but the (t=1) column heartbeats; the report's axis_groups
+    names tensor group 1 (and no data suspect, since every data row
+    contains a stale device symmetrically... the stale column makes
+    every data group contain exactly one stale device, so data ages tie
+    and only the tensor axis diverges)."""
+    import time as _time
+
+    mesh_axes = {"data": 4, "tensor": 2}
+    coords = {i: (i // 2, i % 2) for i in range(8)}
+    wd = res.Watchdog(timeout=60.0, devices=list(range(8)),
+                      mesh_axes=mesh_axes, device_coords=coords,
+                      poll_interval=0.01)
+    try:
+        with wd.step(0):
+            pass  # stamps everyone together
+        _time.sleep(0.05)
+        for d in range(8):
+            if coords[d][1] != 1:  # tensor column 1 goes silent
+                wd.beat(d)
+        report = wd.report()
+        ax = report["axis_groups"]
+        assert ax["mesh_axes"] == mesh_axes
+        assert ax["suspect"].get("tensor") == 1
+        assert "data" not in ax["suspect"]  # ties implicate nothing
+        g1 = ax["groups"]["tensor"]["1"]
+        g0 = ax["groups"]["tensor"]["0"]
+        assert g1["max_age_s"] > g0["max_age_s"]
+        # a lost device dominates the attribution
+        wd.mark_lost([7])
+        ax2 = wd.axis_report()
+        assert 7 in ax2["groups"]["tensor"]["1"]["lost"]
+        assert ax2["suspect"]["tensor"] == 1
+    finally:
+        wd.close()
+
+
+def test_watchdog_mesh_derives_axes():
+    """Passing a jax Mesh derives mesh_axes + device coordinates."""
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 1, 2),
+                ("data", "pipeline", "tensor"))
+    with res.Watchdog(timeout=60.0, mesh=mesh) as wd:
+        assert wd.mesh_axes == {"data": 4, "pipeline": 1, "tensor": 2}
+        assert len(wd.device_coords) == 8
+        with wd.step(0):
+            pass
+        assert wd.report()["axis_groups"]["mesh_axes"]["tensor"] == 2
+
+
+def test_watchdog_never_beaten_group_ranks_stalest():
+    """A live device with NO heartbeat yet is infinitely stale, not
+    infinitely fresh: a group wedged before its first completed step
+    must become the suspect, never the freshly-beaten healthy group
+    (and its max_age_s stays None — no observation — so the report
+    stays JSON-safe)."""
+    import json as _json
+
+    mesh_axes = {"data": 2, "tensor": 1}
+    coords = {0: (0, 0), 1: (1, 0)}
+    with res.Watchdog(timeout=60.0, devices=[0, 1], mesh_axes=mesh_axes,
+                      device_coords=coords) as wd:
+        wd.beat(0)  # data group 0 healthy; group 1 never heartbeat
+        ax = wd.axis_report()
+        assert ax["suspect"].get("data") == 1
+        assert ax["groups"]["data"]["1"]["max_age_s"] is None
+        _json.dumps(ax)
+
+
+def test_kill_mid_async_save_3d_newest_intact_shard_set_wins(
+        chaos_ckpt_dir):
+    """The 3-D chaos acceptance case (ISSUE 6 satellite): step 1 lands
+    intact; the step-2 ASYNC multi-axis save dies mid-shard-set; step 3
+    lands but a TENSOR-leg coordinate's shard file is corrupted.
+    restore_resilient must skip step 3 (one bad coordinate condemns the
+    whole set), never see a partial step 2, and land on step 1."""
+    state, shardings, axes = _synthetic_state_3d((2, 1, 2), 32)
+    ckpt.save_checkpoint(str(chaos_ckpt_dir), state, step=1,
+                         shardings=shardings, shard_axes=axes)
+    with chaos.FaultyStore(fail_events=("write_shard",),
+                           fail_times=None) as store:
+        ckpt.save_checkpoint(str(chaos_ckpt_dir), state, step=2,
+                             shardings=shardings, shard_axes=axes,
+                             blocking=False)
+        with pytest.raises(res.AsyncSaveError):
+            res.wait_for_save()
+    assert store.failures_injected >= 1
+    assert not os.path.isdir(ckpt.step_dir(str(chaos_ckpt_dir), 2))
+    ckpt.save_checkpoint(str(chaos_ckpt_dir), state, step=3,
+                         shardings=shardings, shard_axes=axes)
+    chaos.corrupt_shard(str(chaos_ckpt_dir), 3, (1, 0, 1))  # tp leg
+    target = _synthetic_state_3d((2, 1, 2), 32)[0]
+    with pytest.warns(res.CheckpointFallbackWarning) as record:
+        restored, step = res.restore_resilient(str(chaos_ckpt_dir),
+                                               target)
+    assert step == 1
+    assert any("step 3" in str(w.message) for w in record)
+    np.testing.assert_array_equal(np.asarray(restored[1]["exp_avg"]),
+                                  np.asarray(state[1]["exp_avg"]))
+
+
+def test_reshard_tree_in_memory_multi_axis():
+    """reshard_tree / reshard_zero_state(lead_shape=...) — the in-memory
+    twins of the format-4 reshard — agree with the on-disk contract."""
+    from apex_tpu.contrib.optimizers import (
+        DistributedFusedAdam, ShardedOptState, reshard_zero_state)
+    from apex_tpu.multi_tensor.flat import reshard_tree
+
+    params = {"w": jnp.asarray(np.random.RandomState(1).randn(300),
+                               jnp.float32)}
+    opt = DistributedFusedAdam()
+    sch8 = opt.make_schema(params, 8)
+    sch4 = opt.make_schema(params, 4)
+    rng = np.random.RandomState(2)
+    raw = sum(sch8.sizes)
+
+    def _zeroed(shape):
+        a = rng.randn(int(np.prod(shape))).astype(np.float32)
+        a[raw:] = 0
+        return jnp.asarray(a.reshape(shape))
+
+    stacked = ShardedOptState(
+        step=jnp.broadcast_to(jnp.asarray(3, jnp.int32), (4, 1, 2)),
+        exp_avg=_zeroed((4, 1, 2, sch8.total // 8)),
+        exp_avg_sq=_zeroed((4, 1, 2, sch8.total // 8)))
+    out = reshard_zero_state(stacked, lead_shape=(2, 2, 1), schema=sch4)
+    assert out.exp_avg.shape == (2, 2, 1, sch4.total // 4)
+    assert np.all(np.asarray(out.step) == 3)
+    assert out.step.shape == (2, 2, 1)
+    for a, b in ((out.exp_avg, stacked.exp_avg),
+                 (out.exp_avg_sq, stacked.exp_avg_sq)):
+        _assert_flat_parity(a, b, bitwise=True)
+    # reshard_tree: same result through the spec-driven tree API
+    spec = ShardedOptState(step=P("data", "pipeline", "tensor"),
+                           exp_avg=P("data", "pipeline", "tensor"),
+                           exp_avg_sq=P("data", "pipeline", "tensor"))
+    out2 = reshard_tree(
+        stacked, spec, spec,
+        target=ShardedOptState(
+            step=jnp.zeros((2, 2, 1), jnp.int32),
+            exp_avg=jnp.zeros((2, 2, 1, sch4.total // 4)),
+            exp_avg_sq=jnp.zeros((2, 2, 1, sch4.total // 4))),
+        axes_from={"data": 4, "pipeline": 1, "tensor": 2},
+        axes_to={"data": 2, "pipeline": 2, "tensor": 1})
+    for a, b in zip(jax.tree_util.tree_leaves(out2),
+                    jax.tree_util.tree_leaves(out)):
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32))
+
+
+@pytest.mark.slow  # three flagship jit constructions + 13 train steps
+def test_3d_device_loss_resumes_on_best_submesh_with_golden(tmp_path):
+    """ISSUE 6 acceptance: an 8-device run sharded (dp=4, tp=2) loses a
+    device at step 3 → elastic rebuild on the best surviving submesh
+    (dp shrinks to 2, tp=2 survives) → restore from the multi-axis
+    format-4 shard set → the resumed loss trajectory matches the
+    pre-loss golden run (same topology, uninterrupted) at ≤ 1 bf16
+    ulp."""
+    cfg = _toy_cfg()
+    batches = _golden_batches(cfg, 6)
+
+    # the pre-loss golden: uninterrupted (4, 2, 1) run
+    golden = []
+    build_g = flagship_elastic_build(cfg, plan="bf16_fit", lr=1e-3,
+                                     on_loss=golden.append)
+    step_fn, state, _ = build_g(jax.devices()[:8], mesh_shape=(4, 2, 1))
+    for b in batches:
+        state, _ = step_fn(state, b)
+    assert len(golden) == 6
+
+    losses = []
+    build = flagship_elastic_build(cfg, plan="bf16_fit", lr=1e-3,
+                                   on_loss=losses.append)
+    dl = chaos.DeviceLoss(at_step=3, device_ids=jax.devices()[4:6])
+    result = res.run_elastic_training(
+        build, jax.devices()[:8], batches,
+        ckpt_dir=str(tmp_path / "ckpt"), save_every=1, on_step=dl.poll,
+        max_restarts=2, mesh_shape=(4, 2, 1), batch_size=8)
+    assert result.restarts == 1
+    assert result.mesh_shape == (2, 2, 1)  # dp shrank, tp survived
+    assert len(result.devices) == 4
+    assert result.lost_devices == [4, 5]
+    assert result.step == 6
+
+    # the final checkpoint on disk is a format-4 multi-axis shard set
+    import json
+
+    with open(os.path.join(ckpt.step_dir(str(tmp_path / "ckpt"), 6),
+                           "manifest.json")) as f:
+        man = json.load(f)
+    assert man["format"] == 4
+    assert man["topology"]["mesh_axes"] == {"data": 2, "pipeline": 1,
+                                            "tensor": 2}
+
+    # 7 losses: steps 1-3 on (4,2,1), then the replayed step 3 and
+    # steps 4-6 on the (2,2,1) submesh after the step-2 restore
+    assert len(losses) == 7
+    np.testing.assert_array_equal(losses[:3], golden[:3])
     for got, want in zip(losses[3:], golden[2:]):
         assert _bf16_ulp_diff(np.float32(got), np.float32(want)) <= 1, (
             losses, golden)

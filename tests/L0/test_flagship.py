@@ -41,6 +41,19 @@ def _batch(cfg, b=8, seed=1):
     return tokens, jnp.roll(tokens, -1, axis=-1)
 
 
+@pytest.fixture(scope="module")
+def flagship_bf16_fit():
+    """ONE bf16_fit flagship construction shared by every test in this
+    module that steps the default toy config (ISSUE 6 wall-clock
+    satellite: the 8-device jit construction is the dominant cost —
+    build it once per module, not once per test).  donate=False so each
+    test can step from the pristine (params, opt_state) snapshot."""
+    cfg = gpt1p3b_config(**TOY_KW)
+    return cfg, build_flagship_train_step(
+        cfg, plan="bf16_fit", lr=1e-3, devices=jax.devices()[:N_DEV],
+        donate=False)
+
+
 def _unsharded_reference(cfg, plan, tokens, labels, steps, lr):
     """Plain (unsharded) FusedAdam trajectory of the identical model —
     the parity baseline the reference's test_dist_adam.py compares
@@ -90,14 +103,19 @@ def _unsharded_reference(cfg, plan, tokens, labels, steps, lr):
     # ulps bound the two steps
     ("bf16_fit", True, 2 ** -7),
 ])
-def test_zero_step_parity_vs_unsharded(plan_name, compute_bf16, tol):
+def test_zero_step_parity_vs_unsharded(plan_name, compute_bf16, tol,
+                                       flagship_bf16_fit):
     cfg = gpt1p3b_config(bf16=compute_bf16, **TOY_KW)
     plan = FIT_PLANS[plan_name]
     tokens, labels = _batch(cfg)
 
-    fs = build_flagship_train_step(
-        cfg, plan=plan_name, lr=1e-3, devices=jax.devices()[:N_DEV],
-        donate=False)
+    if plan_name == "bf16_fit" and compute_bf16:
+        # the default toy construction — reuse the module's shared build
+        _, fs = flagship_bf16_fit
+    else:
+        fs = build_flagship_train_step(
+            cfg, plan=plan_name, lr=1e-3, devices=jax.devices()[:N_DEV],
+            donate=False)
     p, s = fs.params, fs.opt_state
     for _ in range(2):
         p, s, loss = fs.step(p, s, tokens, labels)
@@ -114,10 +132,8 @@ def test_zero_step_parity_vs_unsharded(plan_name, compute_bf16, tol):
     assert maxdw <= tol, (plan_name, maxdw)
 
 
-def test_flagship_loss_decreases():
-    cfg = gpt1p3b_config(**TOY_KW)
-    fs = build_flagship_train_step(
-        cfg, plan="bf16_fit", lr=1e-3, devices=jax.devices()[:N_DEV])
+def test_flagship_loss_decreases(flagship_bf16_fit):
+    cfg, fs = flagship_bf16_fit
     tokens, labels = _batch(cfg)
     p, s = fs.params, fs.opt_state
     losses = []

@@ -55,6 +55,7 @@ def test_grads_match_bf16_class(smoothing):
         assert float(jnp.max(jnp.abs(a32 - b32))) / scale < 2e-2
 
 
+@pytest.mark.slow  # full-model fused-vs-unfused parity (ISSUE 6 wall-clock)
 def test_gpt_head_uses_fused_path_and_matches():
     """GPT tp=1 losses via the fused head vs the logits+vocab-CE path."""
     from apex_tpu.transformer import parallel_state

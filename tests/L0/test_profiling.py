@@ -139,6 +139,7 @@ class TestTraceReport:
         assert ops[0].name == "dot.3"  # sorted by time
         assert names["dot.3"].frac_of_device == pytest.approx(0.6)
 
+    @pytest.mark.slow  # real trace capture round-trip (ISSUE 6 wall-clock)
     def test_top_ops_report_end_to_end(self, tmp_path):
         """Capture a real (CPU) trace and attribute per-op time; on
         platforms whose trace lacks device lanes the host timeline is
