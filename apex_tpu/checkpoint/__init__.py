@@ -13,6 +13,7 @@ from apex_tpu.checkpoint.checkpoint import (
     CheckpointCorruptionError,
     RetryPolicy,
     latest_step,
+    load_data_state,
     restore_checkpoint,
     save_checkpoint,
     shard_file,
@@ -28,6 +29,7 @@ __all__ = [
     "restore_checkpoint",
     "verify_checkpoint",
     "latest_step",
+    "load_data_state",
     "shard_file",
     "shard_file_coords",
     "step_dir",

@@ -252,6 +252,7 @@ def save_checkpoint(
     retry: Optional[RetryPolicy] = None,
     shard_axis: Optional[str] = None,
     shard_axes: Optional[Any] = None,
+    data_state: Optional[dict] = None,
 ) -> str:
     """Write ``tree`` as checkpoint ``step`` under ``ckpt_dir``.
 
@@ -304,6 +305,13 @@ def save_checkpoint(
     topologies").  Mutually exclusive with ``shard_axis``; format-3
     checkpoints keep restoring through the same path.
 
+    ``data_state`` — optional compact JSON record of the input
+    pipeline's position (the checkpointable-iterator protocol's
+    ``state_dict()``, docs/data.md).  Stored under the manifest's
+    ``data_state`` key — atomically with the arrays, through the async
+    writer too — and read back via :func:`load_data_state`, so model
+    state and iterator position can never land in different steps.
+
     Returns the checkpoint directory path.
     """
     # Only process 0 writes; the guard precedes any device_get so non-writing
@@ -334,6 +342,14 @@ def save_checkpoint(
         if not shard_axes or any(n < 1 for n in shard_axes.values()):
             raise ValueError(f"invalid shard_axes {shard_axes!r}: need at "
                              "least one axis, every size >= 1")
+
+    if data_state is not None:
+        try:
+            data_state = json.loads(json.dumps(data_state))
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                f"data_state must be JSON-serializable (it rides the "
+                f"manifest): {e}") from e
 
     leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
     spec_map = _spec_map(shardings, tree) if shardings is not None else {}
@@ -466,6 +482,8 @@ def save_checkpoint(
         manifest["topology"] = {"mesh_axes": dict(shard_axes)}
         if mesh_shape is not None:
             manifest["topology"]["mesh_shape"] = mesh_shape
+    if data_state is not None:
+        manifest["data_state"] = data_state
 
     # everything below is pure host/disk work on the snapshot — safe to run
     # on the background writer thread
@@ -784,6 +802,23 @@ def verify_checkpoint(ckpt_dir: str, step: Optional[int] = None) -> int:
             raise FileNotFoundError(f"no checkpoint found under {ckpt_dir}")
     _load_manifest_and_data(step_dir(ckpt_dir, step), verify=True)
     return step
+
+
+def load_data_state(ckpt_dir: str,
+                    step: Optional[int] = None) -> Optional[dict]:
+    """The ``data_state`` record saved with checkpoint ``step``
+    (default: latest), or None when that checkpoint was saved without
+    one.  The restore-side half of exactly-once resume: restore the
+    model tree with :func:`restore_checkpoint` / ``restore_resilient``
+    at step N, then feed this record to the iterator's
+    ``load_state_dict`` — both came from ONE atomic manifest, so they
+    cannot disagree about the position."""
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found under {ckpt_dir}")
+    with open(os.path.join(step_dir(ckpt_dir, step), _MANIFEST)) as f:
+        return json.load(f).get("data_state")
 
 
 def restore_checkpoint(

@@ -1,116 +1,73 @@
-"""Data pipeline: native prefetching record loader + synthetic fallback.
+"""Fault-tolerant input pipeline (ISSUE 7, ROADMAP item 5a).
 
-The reference's examples feed from DALI or torch DataLoader worker
-processes (examples/imagenet/main_amp.py --data-backend {dali-gpu,
-dali-cpu, pytorch}).  The TPU equivalent here is a **C++ threaded
-loader** (apex_tpu/_native/dataloader.cpp): fixed-size binary records
-across one or more files, shuffled per epoch, read by a worker-thread
-pool into a prefetch ring — the host stays ahead of the device without
-Python in the hot path.
+The data plane's resilience story, mirroring what
+:mod:`apex_tpu.resilience` gives the compute plane:
 
-Format: a record is ``record_bytes`` raw bytes; the caller provides a
-``decode`` function from the batch byte matrix to arrays (e.g. image
-uint8 [H,W,C] + int32 label).  :func:`write_records` produces the files.
+- :class:`ShardedRecordIterator` — deterministic, **checkpointable**
+  sharded iterator: seeded shuffle windows, per-slot substreams, dp-axis
+  shard ownership as a slot range; its full position serializes to a
+  compact ``data_state`` record saved through
+  ``save_checkpoint(..., data_state=...)`` so a killed run resumes
+  **exactly-once** (no replayed, no dropped samples), including across
+  elastic dp→dp' restarts;
+- :class:`AsyncPrefetcher` — double-buffered background prefetch with
+  ``device_put`` overlap, bounded-queue backpressure, ``data_wait``
+  accounting, ``data_stall`` telemetry, and loud loader-thread death;
+- **degradation** — damaged records are quarantined
+  (:class:`QuarantinePolicy`, hard-fail via
+  :class:`QuarantineOverflowError` above a configurable rate); slow or
+  dead shard reads ride a retry → backoff → re-assignment ladder
+  (:class:`~apex_tpu.data.records.RecordFileSet`);
+- :class:`NativeRecordLoader` — the C++ threaded loader
+  (``_native/dataloader.cpp``), kept as the optional non-checkpointable
+  fast path behind the prefetcher (decision recorded in docs/data.md).
+
+See docs/data.md for the state format, the exactly-once contract, the
+quarantine policy, and the chaos knobs
+(:mod:`apex_tpu.resilience.chaos`: ``corrupt_record``,
+``SlowShardRead``, ``DropShard``).
 """
 
-from __future__ import annotations
-
-import ctypes
-import os
-from typing import Callable, Iterator, Optional, Sequence
-
-import numpy as np
-
-from apex_tpu import _native
+from apex_tpu.data.iterator import (  # noqa: F401
+    DATA_STATE_VERSION,
+    QuarantineOverflowError,
+    QuarantinePolicy,
+    ShardedRecordIterator,
+    merge_data_states,
+)
+from apex_tpu.data.native import (  # noqa: F401
+    NativeRecordLoader,
+    native_available,
+)
+from apex_tpu.data.prefetch import (  # noqa: F401
+    AsyncPrefetcher,
+    DataLoaderError,
+)
+from apex_tpu.data.records import (  # noqa: F401
+    RECORD_CRC_BYTES,
+    DataShardError,
+    RecordFileSet,
+    check_record_crc,
+    set_read_hook,
+    write_checksummed_records,
+    write_records,
+)
 
 __all__ = [
+    "AsyncPrefetcher",
+    "DATA_STATE_VERSION",
+    "DataLoaderError",
+    "DataShardError",
     "NativeRecordLoader",
-    "write_records",
+    "QuarantineOverflowError",
+    "QuarantinePolicy",
+    "RECORD_CRC_BYTES",
+    "RecordFileSet",
+    "ShardedRecordIterator",
+    "check_record_crc",
+    "merge_data_states",
     "native_available",
+    "set_read_hook",
+    "write_checksummed_records",
+    "write_records",
 ]
-
-
-def native_available() -> bool:
-    return _native.available()
-
-
-def write_records(path: str, records: np.ndarray) -> None:
-    """Write [n, record_bytes] uint8 rows as one record file."""
-    arr = np.ascontiguousarray(records, np.uint8)
-    assert arr.ndim == 2
-    with open(path, "wb") as f:
-        f.write(arr.tobytes())
-
-
-class NativeRecordLoader:
-    """Iterator over batches of fixed-size records, prefetched by the C++
-    worker pool.
-
-    Yields ``decode(batch_bytes)`` where ``batch_bytes`` is a
-    [batch, record_bytes] uint8 array (a fresh buffer each step — safe to
-    hand straight to ``jax.device_put``).  The stream is infinite with a
-    deterministic per-epoch reshuffle; use :attr:`batches_per_epoch` to
-    delimit epochs (the reference CLI's len(loader) role).
-    """
-
-    def __init__(self, paths: Sequence[str], record_bytes: int,
-                 batch_size: int, *, shuffle: bool = True, seed: int = 0,
-                 num_threads: int = 4, queue_depth: int = 4,
-                 decode: Optional[Callable[[np.ndarray], object]] = None):
-        lib = _native.get_lib()
-        if lib is None:
-            raise RuntimeError(
-                f"native loader unavailable: {_native.build_error()}")
-        self._lib = lib
-        self.record_bytes = int(record_bytes)
-        self.batch_size = int(batch_size)
-        self.decode = decode
-        enc = [os.fsencode(p) for p in paths]
-        arr = (ctypes.c_char_p * len(enc))(*enc)
-        self._h = lib.axl_open(arr, len(enc), self.record_bytes,
-                               self.batch_size, 1 if shuffle else 0,
-                               seed, num_threads, queue_depth)
-        if not self._h:
-            raise RuntimeError(
-                f"axl_open failed for {list(paths)[:3]}... (records must "
-                f"be >= batch_size and files readable)")
-        self.num_records = lib.axl_num_records(self._h)
-
-    @property
-    def batches_per_epoch(self) -> int:
-        return self.num_records // self.batch_size
-
-    @property
-    def error_count(self) -> int:
-        """Records zero-filled because a read failed (truncated/rotated
-        file).  Nonzero means delivered data is suspect — check after
-        each epoch (or each batch for strict pipelines)."""
-        return int(self._lib.axl_error_count(self._h)) if self._h else 0
-
-    def next_batch(self) -> object:
-        out = np.empty((self.batch_size, self.record_bytes), np.uint8)
-        rc = self._lib.axl_next(self._h, ctypes.c_void_p(out.ctypes.data))
-        if rc != 0:
-            raise RuntimeError("axl_next failed (loader closed?)")
-        return self.decode(out) if self.decode is not None else out
-
-    def __iter__(self) -> Iterator[object]:
-        while True:
-            yield self.next_batch()
-
-    def close(self) -> None:
-        if getattr(self, "_h", None):
-            self._lib.axl_close(self._h)
-            self._h = None
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.close()
-
-    def __del__(self):  # pragma: no cover - GC timing
-        try:
-            self.close()
-        except Exception:
-            pass

@@ -32,6 +32,23 @@ CPU mesh):
   stalls (a straggling/hung collective); the watchdog's deadline must
   fire and escalate.
 
+Data-plane faults (ISSUE 7 — the input-pipeline tier, reproduced
+against :mod:`apex_tpu.data`'s read hook the way the storage faults
+ride the checkpoint hook):
+
+- :func:`corrupt_record` — flip a byte inside one record's *payload* on
+  disk; a checksummed pipeline must fail exactly that record's CRC and
+  quarantine it (skip + count + ``data_quarantine`` telemetry) without
+  killing the run;
+- :class:`SlowShardRead` — inject per-read latency on a chosen shard
+  file (a straggling serving host); the reader's
+  ``slow_read_threshold`` / the prefetcher's stall accounting must
+  surface it as ``data_stall`` telemetry;
+- :class:`DropShard` — reads of a chosen shard fail until the reader
+  *re-assigns* the shard (reopens it through a fresh handle — the
+  stand-in for a different serving replica); recovery must happen via
+  the retry → re-assign ladder, never a hang.
+
 Test-only by design: nothing here is imported by production modules, and
 the hook slot is cleared by the context managers (plus the test harness's
 chaos fixture) even when the simulated crash propagates.
@@ -284,6 +301,121 @@ def slow_collective(step_fn, *, at_step: int, delay: float,
 
     wrapped.calls = calls
     return wrapped
+
+
+# ---------------------------------------------------------------------------
+# Data-plane faults (ISSUE 7)
+# ---------------------------------------------------------------------------
+
+
+def corrupt_record(path: str, index: int, record_bytes: int) -> int:
+    """Flip one byte in the middle of record ``index``'s PAYLOAD in
+    shard file ``path`` (fixed-size ``record_bytes`` records).  The
+    flip deliberately avoids the CRC trailer: a checksummed pipeline
+    must catch a damaged payload, not a damaged checksum.  Returns the
+    flipped byte offset."""
+    from apex_tpu.data.records import RECORD_CRC_BYTES
+
+    payload = record_bytes - RECORD_CRC_BYTES
+    off = index * record_bytes + max(0, payload // 2)
+    _flip_byte(path, off)
+    return off
+
+
+class _DataReadFault:
+    """Base for data-plane read-hook injectors: installs itself on
+    ``apex_tpu.data.records.set_read_hook`` as a context manager,
+    chaining to any previously-installed hook."""
+
+    def __init__(self, path: str, *, telemetry=None):
+        self.path = os.path.abspath(path)
+        self.telemetry = telemetry
+        self.reads = 0
+        self._prev_hook = None
+
+    def _match(self, path: str) -> bool:
+        return os.path.abspath(path) == self.path
+
+    def _hook(self, event: str, path: str) -> None:
+        raise NotImplementedError
+
+    def __enter__(self):
+        from apex_tpu.data import records as _records
+
+        self._prev_hook = _records.set_read_hook(self._hook)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        from apex_tpu.data import records as _records
+
+        _records.set_read_hook(self._prev_hook)
+        self._prev_hook = None
+
+
+class SlowShardRead(_DataReadFault):
+    """Sleep ``delay`` seconds on each read of ``path`` (the first
+    ``times`` reads; None = every read) — a straggling shard-serving
+    host.  The reader's ``slow_read_threshold`` must classify the reads
+    as slow and the pipeline's telemetry must show ``data_stall``."""
+
+    def __init__(self, path: str, *, delay: float, times: Optional[int] = 1,
+                 telemetry=None):
+        super().__init__(path, telemetry=telemetry)
+        self.delay = float(delay)
+        self.times = times
+        self.slowed = 0
+
+    def _hook(self, event: str, path: str) -> None:
+        if self._prev_hook is not None:
+            self._prev_hook(event, path)
+        if event != "read_record" or not self._match(path):
+            return
+        self.reads += 1
+        if self.times is not None and self.slowed >= self.times:
+            return
+        self.slowed += 1
+        if self.telemetry is not None:
+            self.telemetry.emit("fault_injected", kind="slow_read",
+                                path=path, delay_s=self.delay)
+        time.sleep(self.delay)
+
+
+class DropShard(_DataReadFault):
+    """Reads of ``path`` raise until the reader RE-ASSIGNS the shard
+    (the ``reopen_shard`` hook event — a fresh handle standing in for a
+    different serving replica), after which reads succeed.  Asserting
+    on :attr:`reassigned` proves recovery took the re-assignment path
+    rather than luck.  ``fail_after_reassign=True`` keeps failing even
+    the re-assigned handle — the shard is truly gone and the pipeline
+    must surface :class:`~apex_tpu.data.DataShardError` instead of
+    hanging."""
+
+    def __init__(self, path: str, *, fail_after_reassign: bool = False,
+                 telemetry=None):
+        super().__init__(path, telemetry=telemetry)
+        self.fail_after_reassign = fail_after_reassign
+        self.failures_injected = 0
+        self.reassigned = False
+
+    def _hook(self, event: str, path: str) -> None:
+        if self._prev_hook is not None:
+            self._prev_hook(event, path)
+        if not self._match(path):
+            return
+        if event == "reopen_shard":
+            self.reassigned = True
+            return
+        if event != "read_record":
+            return
+        self.reads += 1
+        if self.reassigned and not self.fail_after_reassign:
+            return
+        self.failures_injected += 1
+        if self.telemetry is not None and self.failures_injected == 1:
+            self.telemetry.emit("fault_injected", kind="drop_shard",
+                                path=path)
+        raise OSError(f"injected drop_shard fault: {path} unreachable "
+                      "from this handle")
 
 
 class SimulatedPreemption:

@@ -515,8 +515,9 @@ class ElasticResult:
 def run_elastic_training(
     build: Callable[[Sequence], tuple],
     devices: Sequence,
-    batches: Sequence,
+    batches: Optional[Sequence] = None,
     *,
+    data_iter=None,
     ckpt_dir: str,
     save_every: int = 1,
     keep: Optional[int] = None,
@@ -563,6 +564,21 @@ def run_elastic_training(
     4. resumes from the restored step with the remaining ``batches``
        (which must therefore be a Sequence, not a one-shot iterator).
 
+    ``data_iter`` (instead of ``batches``, ISSUE 7): a checkpointable
+    input-pipeline iterator (``state_dict``/``load_state_dict`` — e.g.
+    :class:`apex_tpu.data.ShardedRecordIterator`, optionally behind
+    :class:`~apex_tpu.data.AsyncPrefetcher`).  Saves then carry the
+    iterator position in the checkpoint manifest (``data_state``), and
+    the device-loss recovery arc restores it alongside the model state
+    — *cross-topology included*: the iterator's slot-cursor state is
+    dp-decomposition-independent, so a dp→dp' rebuild re-partitions
+    shard ownership by re-slicing while the consumed sample-id stream
+    stays bitwise identical to an uninterrupted run (docs/data.md).  A
+    plain generator here is rejected (silent replay of training data is
+    exactly the failure mode this parameter closes); a recovery that
+    finds a checkpoint saved *without* ``data_state`` raises instead of
+    guessing the position.
+
     ``select_devices(survivors) -> devices`` picks the rebuild submesh
     from the raw survivor list — a data-sharded step needs the global
     batch to divide the mesh, so losing 2 of 8 devices usually means
@@ -600,11 +616,46 @@ def run_elastic_training(
     exception path has already flushed a ``postmortem_*.jsonl`` by the
     time the rebuild starts.
     """
-    from apex_tpu.checkpoint.checkpoint import _complete_steps
+    from apex_tpu.checkpoint.checkpoint import (_complete_steps,
+                                                load_data_state)
     from apex_tpu.resilience.chaos import DeviceLossError
     from apex_tpu.transformer.testing import run_resilient_training
 
     emit = log_fn or (lambda msg: log.info("%s", msg))
+    data_initial_state = None
+    if data_iter is not None:
+        if batches is not None:
+            raise ValueError("pass batches OR data_iter, not both")
+        if not (hasattr(data_iter, "state_dict")
+                and hasattr(data_iter, "load_state_dict")):
+            raise TypeError(
+                f"data_iter {type(data_iter).__name__} is not "
+                "checkpointable (no state_dict/load_state_dict) — an "
+                "elastic recovery would silently replay or skip "
+                "training data; use apex_tpu.data.ShardedRecordIterator "
+                "(or AsyncPrefetcher around it)")
+        # a restart before any checkpoint exists must rewind the
+        # iterator to where THIS invocation found it, not to zero
+        data_initial_state = data_iter.state_dict()
+        if (isinstance(data_initial_state, dict)
+                and "slots" in data_initial_state
+                and len(data_initial_state["slots"])
+                != data_initial_state.get("batch_size")):
+            # this single-controller loop checkpoints ONE iterator's
+            # state; a rank-local (dp_size>1) slot slice would save a
+            # partial position that a dp→dp' restore cannot re-slice
+            raise ValueError(
+                f"data_iter covers only slots "
+                f"{data_initial_state['slots']} of the "
+                f"{data_initial_state.get('batch_size')}-slot global "
+                "batch (a rank-local dp_size>1 iterator).  Drive this "
+                "loop with the full-batch iterator (dp_size=1) — "
+                "elastic dp→dp' re-partitioning re-slices slot "
+                "ownership from the full vector — or merge per-rank "
+                "states with apex_tpu.data.merge_data_states in a "
+                "multi-process launcher.")
+    elif batches is None:
+        raise ValueError("run_elastic_training needs batches or data_iter")
     devices = list(devices)
     lost: list = []
     restarts = 0
@@ -632,7 +683,9 @@ def run_elastic_training(
     while True:
         try:
             result = run_resilient_training(
-                step_fn, state, batches[step - start_step:],
+                step_fn, state,
+                batches[step - start_step:] if data_iter is None else None,
+                data_iter=data_iter,
                 ckpt_dir=ckpt_dir, save_every=save_every, keep=keep,
                 shardings=shardings,
                 shard_axis=None if shard_axes else shard_axis,
@@ -715,6 +768,22 @@ def run_elastic_training(
             if _complete_steps(ckpt_dir):
                 t_restore = time.monotonic()
                 state, step = restore_zero_checkpoint(ckpt_dir, state)
+                if data_iter is not None:
+                    # same manifest, same step: the iterator resumes at
+                    # exactly the sample the restored weights last saw —
+                    # across a dp→dp' reshape too (the state is global,
+                    # ownership re-slices)
+                    ds = load_data_state(ckpt_dir, step=step)
+                    if ds is None:
+                        raise RuntimeError(
+                            f"checkpoint step {step} carries no "
+                            "data_state but this run trains from a "
+                            "checkpointable data_iter — resuming would "
+                            "replay or skip training data.  The "
+                            "checkpoint was saved by a loop without "
+                            "data_iter wiring; restart from a caller "
+                            "that manages the position.") from e
+                    data_iter.load_state_dict(ds)
                 if telemetry is not None:
                     telemetry.accountant().pause(
                         time.monotonic() - t_restore, "restore")
@@ -737,5 +806,7 @@ def run_elastic_training(
                      f"{step} on the {len(devices)}-device submesh")
             else:
                 step = start_step
+                if data_iter is not None:
+                    data_iter.load_state_dict(data_initial_state)
                 emit("[elastic] no checkpoint yet — restarting from "
                      f"step {step}")

@@ -51,6 +51,9 @@ EVENT_TYPES = frozenset({
     "fault_injected",  # chaos tier injected a fault (test streams)
     "timers",          # pipeline-parallel Timers.log snapshot
     "postmortem",      # flight-recorder flush header
+    "data_stall",      # input pipeline made the step wait (dry prefetch
+                       # queue, slow shard read, shard re-assignment)
+    "data_quarantine",  # a damaged record was skipped and counted
 })
 
 

@@ -51,6 +51,9 @@ PAYLOAD_REQUIRED: Dict[str, Dict[str, tuple]] = {
     "fault_injected": {"kind": (str,)},
     "timers": {"timers_ms": (dict,)},
     "postmortem": {"reason": (str,), "ring_events": (int,)},
+    "data_stall": {"wait_ms": NUMBER, "cause": (str,)},
+    "data_quarantine": {"record_id": (int,), "reason": (str,),
+                        "total": (int,)},
 }
 
 

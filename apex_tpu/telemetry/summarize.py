@@ -63,6 +63,12 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "step_ms_p50": round(percentile(s, 0.50), 3) if s else None,
         "step_ms_p95": round(percentile(s, 0.95), 3) if s else None,
         "step_ms_p99": round(percentile(s, 0.99), 3) if s else None,
+        # data-plane health (ISSUE 7): stall/quarantine counts are
+        # first-class summary fields, not just rows in the counts dict —
+        # an input-bound or data-damaged run must be visible on the one-
+        # screen view
+        "data_stalls": counts.get("data_stall", 0),
+        "records_quarantined": counts.get("data_quarantine", 0),
     }
     if len(run_ids) > 1:
         # JsonlSink appends: a restarted job continues its stream file
@@ -115,6 +121,13 @@ def format_summary(s: Dict[str, Any]) -> str:
     if buckets:
         lines.append("time split  " + "  ".join(
             f"{k} {v:.2f}s" for k, v in sorted(buckets.items())))
+    if s.get("data_stalls") or s.get("records_quarantined"):
+        parts = [f"data        stalls {s.get('data_stalls', 0)}"]
+        if s.get("records_quarantined"):
+            parts.append(f"quarantined {s['records_quarantined']}")
+        if buckets and buckets.get("data_wait"):
+            parts.append(f"wait {buckets['data_wait']:.2f}s")
+        lines.append("  ".join(parts))
     if s.get("stop_reason"):
         lines.append(f"stop        {s['stop_reason']}"
                      + (f"  ({s.get('steps_per_sec')} steps/s)"
@@ -135,6 +148,7 @@ _DIFF_ROWS = (
     ("step_ms_p99", "p99 (ms)", "{:.2f}"),
     ("goodput", "goodput", "{:.3f}"),
     ("steps_per_sec", "steps/s", "{:.3f}"),
+    ("data_stalls", "data stalls", "{:d}"),
 )
 
 
@@ -152,7 +166,8 @@ def format_diff(a: Dict[str, Any], b: Dict[str, Any]) -> str:
         if va is not None and vb is not None:
             d = vb - va
             delta = f"{d:+.3f}" if isinstance(d, float) else f"{d:+d}"
-            if va not in (0, None) and key not in ("steps", "skipped_steps"):
+            if va not in (0, None) and key not in ("steps", "skipped_steps",
+                                                   "data_stalls"):
                 delta += f" ({vb / va:.2f}x)"
         else:
             delta = "n/a"

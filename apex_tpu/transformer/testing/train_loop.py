@@ -65,8 +65,9 @@ def _default_scalars(state: Any, finite: Any) -> Dict[str, Any]:
 def run_resilient_training(
     step_fn: Callable[[Any, Any], tuple],
     state: Any,
-    batches: Iterable[Any],
+    batches: Optional[Iterable[Any]] = None,
     *,
+    data_iter: Any = None,
     ckpt_dir: Optional[str] = None,
     save_every: int = 0,
     keep: Optional[int] = None,
@@ -120,9 +121,46 @@ def run_resilient_training(
     - ``on_step(step)`` runs at each step boundary *before* the preemption
       poll (the chaos harness's ``SimulatedPreemption.poll`` and
       ``DeviceLoss.poll`` hook here);
+    - ``data_iter`` (instead of ``batches``): an input-pipeline iterator
+      conforming to the checkpointable-iterator protocol
+      (``state_dict()``/``load_state_dict()``, e.g.
+      :class:`apex_tpu.data.ShardedRecordIterator` — optionally behind
+      :class:`~apex_tpu.data.AsyncPrefetcher`).  Every checkpoint then
+      also records the iterator's position (the manifest ``data_state``
+      key) so a resumed run replays *exactly* the samples an
+      uninterrupted run would have seen — no duplicates, no drops
+      (docs/data.md).  With checkpointing enabled, a plain
+      generator/iterator without the protocol is REJECTED up front:
+      restoring model state while silently rewinding (or fast-
+      forwarding) the data stream is the bug this parameter exists to
+      make impossible;
     - before returning (any path) the loop fences on outstanding async
       writes, so a completed run's checkpoints are durable.
     """
+    if data_iter is not None:
+        if batches is not None:
+            raise ValueError("pass batches OR data_iter, not both")
+        if ckpt_dir is not None and not (
+                hasattr(data_iter, "state_dict")
+                and hasattr(data_iter, "load_state_dict")):
+            raise TypeError(
+                f"data_iter {type(data_iter).__name__} is not "
+                "checkpointable (no state_dict/load_state_dict) but "
+                "checkpointing is enabled — a restored run would "
+                "silently replay or skip training data.  Use "
+                "apex_tpu.data.ShardedRecordIterator (or wrap it in "
+                "AsyncPrefetcher), or pass a Sequence via batches= and "
+                "manage the position yourself.")
+        if ckpt_dir is not None:
+            # probe eagerly: a wrapper (AsyncPrefetcher) around a
+            # non-checkpointable source defines state_dict but raises
+            # inside it — fail NOW, not at the first checkpoint save
+            # hundreds of steps in
+            data_iter.state_dict()
+        batches = data_iter
+    elif batches is None:
+        raise ValueError("run_resilient_training needs batches or "
+                         "data_iter")
     step = start_step
     steps_run = 0
     last_saved: Optional[int] = None
@@ -154,9 +192,16 @@ def run_resilient_training(
         if ckpt_dir is None:
             return
         t0 = time.monotonic()
+        # the iterator position rides the SAME manifest as the model
+        # state (atomic commit), so a restore can never pair step N's
+        # weights with step M's data cursor
+        data_state = (data_iter.state_dict()
+                      if data_iter is not None
+                      and hasattr(data_iter, "state_dict") else None)
         ckpt.save_checkpoint(ckpt_dir, state, step=step, keep=keep,
                              shardings=shardings, shard_axis=shard_axis,
                              shard_axes=shard_axes,
+                             data_state=data_state,
                              blocking=blocking or not async_saves)
         dt = time.monotonic() - t0
         last_saved = step

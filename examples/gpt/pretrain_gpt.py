@@ -20,9 +20,16 @@ Runs unchanged on one real TPU chip or an emulated CPU mesh:
         --seq-length 128 --micro-batch-size 2 --train-iters 20
 
 Data is synthetic token streams by default (the reference test loop does
-the same); pass ``--data-path`` (the Megatron flag) pointing at binary token files
-(uint32 token-id records of seq+1 each) to stream real tokens through
-the native prefetching record loader; ``--save``/``--save-interval``/
+the same); pass ``--data-dir`` (a directory of ``*.bin`` shards holding
+CHECKSUMMED uint32 token records of seq+1 ids each, written by
+``apex_tpu.data.write_checksummed_records``) or ``--data-path`` (the
+Megatron flag: explicit shard files in the legacy RAW format — uint32
+records of seq+1 ids, no CRC trailer) to stream real tokens through the
+fault-tolerant input pipeline (:mod:`apex_tpu.data`), read by
+the checkpointable sharded iterator behind the async prefetcher —
+damaged records are quarantined, the iterator position rides every
+checkpoint (exactly-once resume), and a dying loader thread flushes a
+postmortem instead of hanging the run.  ``--save``/``--save-interval``/
 ``--load`` give checkpoint/resume.
 """
 
@@ -58,6 +65,12 @@ def _extra_args(parser):
     g.add_argument("--remat-policy", default="attn_res",
                    choices=["full", "dots", "attn_res", "attn_res_mlp",
                             "attn_out"])
+    g.add_argument("--data-dir", default=None,
+                   help="directory of *.bin token shards (checksummed "
+                        "uint32 records of seq+1 ids, "
+                        "apex_tpu.data.write_checksummed_records) fed "
+                        "through the checkpointable sharded iterator + "
+                        "async prefetcher; default: synthetic tokens")
     g.add_argument("--vocab-size", type=int, default=51200,
                    help="unpadded vocab; padded to "
                         "--make-vocab-size-divisible-by x tp")
@@ -103,25 +116,57 @@ def build_config(args) -> GPTConfig:
     )
 
 
-def token_batches(args, key):
-    """Yield (tokens, labels) [global_batch, seq] int32 forever."""
+def synthetic_batches(args, key):
+    """Yield synthetic (tokens, labels) [global_batch, seq] int32 forever
+    (the reference test loop's default)."""
     b, s = args.global_batch_size, args.seq_length
-    if args.data_path:
-        from apex_tpu.data import RecordLoader
+    while True:
+        key, k = jax.random.split(key)
+        ids = jax.random.randint(k, (b, s + 1), 0,
+                                 args.padded_vocab_size, jnp.int32)
+        yield ids[:, :-1], ids[:, 1:]
 
-        # each record is one sequence of s+1 token ids (uint32)
-        loader = RecordLoader(list(args.data_path), record_bytes=4 * (s + 1),
-                              batch_size=b, shuffle=True, seed=args.seed)
-        for batch in loader:
-            ids = np.asarray(batch).view(np.uint32).reshape(b, s + 1)
-            ids = (ids % args.padded_vocab_size).astype(np.int32)
-            yield jnp.asarray(ids[:, :-1]), jnp.asarray(ids[:, 1:])
+
+def build_data_iter(args, telemetry=None):
+    """The real-token path (ISSUE 7): ``--data-dir`` / ``--data-path``
+    shards through :class:`~apex_tpu.data.ShardedRecordIterator`
+    (checkpointable, quarantining, retry/re-assign on shard faults)
+    behind :class:`~apex_tpu.data.AsyncPrefetcher` (device_put on the
+    worker thread, ``data_stall`` telemetry)."""
+    import glob
+
+    from apex_tpu.data import AsyncPrefetcher, ShardedRecordIterator
+    from apex_tpu.data.records import RECORD_CRC_BYTES
+
+    if args.data_dir:
+        paths = sorted(glob.glob(os.path.join(args.data_dir, "*.bin")))
+        if not paths:
+            raise SystemExit(f"--data-dir {args.data_dir}: no *.bin shards")
+        checksummed = True
     else:
-        while True:
-            key, k = jax.random.split(key)
-            ids = jax.random.randint(k, (b, s + 1), 0,
-                                     args.padded_vocab_size, jnp.int32)
-            yield ids[:, :-1], ids[:, 1:]
+        # --data-path keeps its documented legacy format: RAW uint32
+        # records of seq+1 ids, no CRC trailer (files written before
+        # the checksummed pipeline existed must keep reading — a silent
+        # 4-byte frame shift would misalign every record)
+        paths = list(args.data_path)
+        checksummed = False
+    b, s = args.global_batch_size, args.seq_length
+    vocab = args.padded_vocab_size
+
+    def decode(mat):
+        ids = np.ascontiguousarray(mat).view(np.uint32).reshape(
+            b, s + 1).astype(np.int64)
+        ids = (ids % vocab).astype(np.int32)
+        return ids[:, :-1], ids[:, 1:]
+
+    rb = 4 * (s + 1) + (RECORD_CRC_BYTES if checksummed else 0)
+    it = ShardedRecordIterator(
+        paths, rb, b, checksummed=checksummed,
+        seed=args.seed, decode=decode, telemetry=telemetry,
+        slow_read_threshold=1.0)
+    return AsyncPrefetcher(
+        it, depth=2, telemetry=telemetry,
+        transfer=lambda tl: tuple(jax.device_put(x) for x in tl))
 
 
 def main(argv=None):
@@ -205,9 +250,6 @@ def main(argv=None):
               f"{args.train_iters}")
         parallel_state.destroy_model_parallel()
         return None
-    batches = token_batches(args, jax.random.PRNGKey(args.seed + 1))
-    for _ in range(step0):
-        next(batches)  # a resumed run must not re-see consumed batches
 
     # telemetry (ISSUE 4): structured stream + crash flight recorder;
     # step events carry the data-wait/step wall split, the loss rides
@@ -236,14 +278,42 @@ def main(argv=None):
                          "global_batch_size": args.global_batch_size,
                          "train_iters": args.train_iters})
 
+    # data (ISSUE 7): real shards ride the checkpointable pipeline —
+    # the iterator position is saved with every checkpoint and restored
+    # with --load, so a preempted run's sample stream has no duplicates
+    # and no drops.  A dying loader thread surfaces as DataLoaderError
+    # at next(batches), which lands in the hard-crash handler below and
+    # flushes the postmortem.
+    use_pipeline = bool(args.data_dir or args.data_path)
+    if use_pipeline:
+        batches = build_data_iter(args, telemetry=bus)
+        if step0:
+            ds = ckpt.load_data_state(args.load, step=step0)
+            if ds is None:
+                raise SystemExit(
+                    f"checkpoint step {step0} under --load carries no "
+                    "data_state but this run streams real data — "
+                    "resuming would silently replay or skip training "
+                    "samples (the checkpoint predates the fault-"
+                    "tolerant pipeline)")
+            batches.load_state_dict(ds)
+    else:
+        batches = synthetic_batches(args, jax.random.PRNGKey(args.seed + 1))
+        for _ in range(step0):
+            next(batches)  # a resumed run must not re-see consumed batches
+
     t0 = time.perf_counter()
     loss = None
     preempted = False
 
     def _save(step, blocking):
         t_save = time.perf_counter()
+        # the iterator position rides the same atomic manifest as the
+        # model state (exactly-once resume, docs/data.md)
         ckpt.save_checkpoint(args.save, (params, opt_state), step=step,
-                             blocking=blocking)
+                             blocking=blocking,
+                             data_state=(batches.state_dict()
+                                         if use_pipeline else None))
         if bus is not None:
             dt_save = time.perf_counter() - t_save
             acct.pause(dt_save, "ckpt_fence")
@@ -340,6 +410,8 @@ def main(argv=None):
     finally:
         if bus is not None:
             uninstall_recompile()
+        if use_pipeline:
+            batches.close()
     if args.save and not preempted and not (
             args.save_interval
             and args.train_iters % args.save_interval == 0):

@@ -1,0 +1,658 @@
+"""Fault-tolerant input pipeline tests (ISSUE 7).
+
+Covers the four pillars of apex_tpu.data:
+
+- **determinism / addressing** — seeded window-shuffle epochs cover
+  every record exactly once; two iterators replay bitwise;
+- **exactly-once resume** — the ``data_state`` record restores the
+  consumed sample-id stream with no duplicates and no drops, through
+  the SIGTERM grace path, the hard ``DeviceLossError`` elastic path,
+  and a dp=4→dp=2 elastic reshard (slot ownership re-slices, the
+  stream is invariant);
+- **degradation** — corrupt records quarantine (skip + count +
+  ``data_quarantine`` event) with a hard-fail ceiling; dead shard
+  handles recover via re-assignment; slow reads surface as
+  ``data_stall``;
+- **prefetching** — bounded-queue backpressure, wait accounting,
+  consumed-cursor state snapshots, and LOUD loader-thread death
+  (postmortem included).
+
+The flagship-fed golden case replays the committed
+``gpt1p3b_toy_data`` fp32-hex baseline (tests/L1 REGEN protocol).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import checkpoint as ckpt
+from apex_tpu import resilience as res
+from apex_tpu import telemetry as tele
+from apex_tpu.data import (
+    AsyncPrefetcher,
+    DataLoaderError,
+    DataShardError,
+    QuarantineOverflowError,
+    QuarantinePolicy,
+    ShardedRecordIterator,
+    merge_data_states,
+    write_checksummed_records,
+)
+from apex_tpu.data import records as data_records
+from apex_tpu.resilience import chaos
+from apex_tpu.transformer.testing import run_resilient_training
+
+N_REC, PAYLOAD, BATCH = 64, 12, 8
+
+
+@pytest.fixture(autouse=True)
+def _clear_read_hook():
+    """Chaos injectors install a module-global read hook; no test may
+    leak one into the next (mirrors the chaos_ckpt_dir discipline)."""
+    yield
+    data_records.set_read_hook(None)
+
+
+@pytest.fixture
+def shards(tmp_path):
+    """Two checksummed shards of 32 records each; payload row i carries
+    the global record id in its first 8 bytes (self-identifying)."""
+    paths, rb = [], None
+    for f in range(2):
+        payloads = np.zeros((N_REC // 2, PAYLOAD), np.uint8)
+        for i in range(N_REC // 2):
+            payloads[i, :8] = np.frombuffer(
+                np.int64(f * (N_REC // 2) + i).tobytes(), np.uint8)
+        p = str(tmp_path / f"shard{f}.bin")
+        rb = write_checksummed_records(p, payloads)
+        paths.append(p)
+    return paths, rb
+
+
+def _make(shards, **kw):
+    paths, rb = shards
+    kw.setdefault("shuffle_window", 16)
+    kw.setdefault("seed", 3)
+    return ShardedRecordIterator(paths, rb, BATCH, checksummed=True, **kw)
+
+
+def _drain_ids(it):
+    out = []
+    for _ in it:
+        out.extend(it.last_ids)
+    return out
+
+
+# ------------------------------------------------- determinism/addressing
+
+
+class TestDeterministicAddressing:
+    def test_epoch_covers_every_record_once(self, shards):
+        ids = _drain_ids(_make(shards, num_batches=N_REC // BATCH))
+        assert sorted(ids) == list(range(N_REC))
+
+    def test_second_epoch_reshuffles_and_covers(self, shards):
+        two = _drain_ids(_make(shards, num_batches=2 * N_REC // BATCH))
+        e1, e2 = two[:N_REC], two[N_REC:]
+        assert sorted(e1) == sorted(e2) == list(range(N_REC))
+        assert e1 != e2  # epoch is folded into the window RNG key
+
+    def test_replay_is_bitwise_and_seed_sensitive(self, shards):
+        a = _drain_ids(_make(shards, num_batches=4))
+        b = _drain_ids(_make(shards, num_batches=4))
+        c = _drain_ids(_make(shards, num_batches=4, seed=4))
+        assert a == b and a != c
+
+    def test_payload_is_the_record(self, shards):
+        it = _make(shards, num_batches=2)
+        for batch in it:
+            got = [int(np.asarray(row[:8]).view(np.int64)[0])
+                   for row in batch]
+            assert got == it.last_ids
+
+    def test_record_at_is_pure(self, shards):
+        it = _make(shards, num_batches=1)
+        pos = [it.record_at(p) for p in range(2 * N_REC)]
+        it2 = _make(shards, num_batches=1)
+        assert pos == [it2.record_at(p) for p in range(2 * N_REC)]
+
+
+# -------------------------------------------------- exactly-once position
+
+
+class TestExactlyOncePosition:
+    def test_state_roundtrip_resumes_identically(self, shards):
+        control = _drain_ids(_make(shards, num_batches=8))
+        it = _make(shards, num_batches=8)
+        pre = []
+        for _ in range(3):
+            next(it)
+            pre.extend(it.last_ids)
+        st = it.state_dict()
+        it2 = _make(shards, num_batches=8)
+        it2.load_state_dict(st)
+        assert pre + _drain_ids(it2) == control
+
+    def test_dp4_to_dp2_repartition_preserves_stream(self, shards):
+        control = _drain_ids(_make(shards, num_batches=8))
+        per_batch = [sorted(control[i * BATCH:(i + 1) * BATCH])
+                     for i in range(8)]
+        views = [_make(shards, dp_rank=r, dp_size=4, num_batches=3)
+                 for r in range(4)]
+        got = [[] for _ in range(3)]
+        for v in views:
+            for i in range(3):
+                next(v)
+                got[i].extend(v.last_ids)
+        merged = merge_data_states([v.state_dict() for v in views])
+        views2 = [_make(shards, dp_rank=r, dp_size=2, num_batches=8)
+                  for r in range(2)]
+        got2 = [[] for _ in range(5)]
+        for v in views2:
+            v.load_state_dict(merged)
+        for v in views2:
+            for i in range(5):
+                next(v)
+                got2[i].extend(v.last_ids)
+        assert [sorted(b) for b in got] + [sorted(b) for b in got2] \
+            == per_batch
+
+    def test_state_mismatch_raises(self, shards, tmp_path):
+        it = _make(shards, num_batches=4)
+        next(it)
+        st = it.state_dict()
+        other = _make(shards, num_batches=4, seed=99)
+        with pytest.raises(ValueError, match="fingerprint"):
+            other.load_state_dict(st)
+        bad = dict(st, batch_size=4)
+        with pytest.raises(ValueError, match="batch_size"):
+            _make(shards, num_batches=4).load_state_dict(bad)
+        rank_state = _make(shards, dp_rank=0, dp_size=2,
+                           num_batches=4).state_dict()
+        with pytest.raises(ValueError, match="merge"):
+            _make(shards, num_batches=4).load_state_dict(rank_state)
+
+    def test_data_state_rides_manifest_async_save(self, shards,
+                                                  chaos_ckpt_dir):
+        it = _make(shards, num_batches=4)
+        next(it)
+        ckpt.save_checkpoint(str(chaos_ckpt_dir), {"w": jnp.zeros(4)},
+                             step=1, data_state=it.state_dict(),
+                             blocking=False)
+        res.wait_for_save()
+        ds = ckpt.load_data_state(str(chaos_ckpt_dir))
+        assert ds == it.state_dict()
+        # a save without data_state reads back as None
+        ckpt.save_checkpoint(str(chaos_ckpt_dir), {"w": jnp.zeros(4)},
+                             step=2)
+        assert ckpt.load_data_state(str(chaos_ckpt_dir), step=2) is None
+
+    def test_unserializable_data_state_rejected(self, chaos_ckpt_dir):
+        with pytest.raises(ValueError, match="JSON"):
+            ckpt.save_checkpoint(str(chaos_ckpt_dir), {"w": jnp.zeros(4)},
+                                 step=1, data_state={"x": object()})
+
+
+# --------------------------------------------------- degradation layer
+
+
+class TestDegradation:
+    def test_quarantine_skips_counts_and_emits(self, shards):
+        paths, rb = shards
+        chaos.corrupt_record(paths[0], 5, rb)
+        mem = tele.MemorySink()
+        bus = tele.TelemetryBus("q", sinks=[mem])
+        it = _make(shards, num_batches=8, telemetry=bus,
+                   quarantine=QuarantinePolicy(max_rate=0.5,
+                                               min_count=64))
+        ids = _drain_ids(it)
+        assert it.quarantined == 1
+        assert 5 not in ids and len(ids) == N_REC  # skipped, not dropped
+        ev = [e for e in mem.events if e["type"] == "data_quarantine"]
+        assert len(ev) == 1 and ev[0]["record_id"] == 5 \
+            and ev[0]["reason"] == "crc_mismatch"
+        for e in mem.events:
+            tele.validate_event(e)
+
+    def test_quarantine_is_deterministic_across_resume(self, shards):
+        paths, rb = shards
+        chaos.corrupt_record(paths[0], 5, rb)
+        quar = QuarantinePolicy(max_rate=0.5, min_count=64)
+        control = _drain_ids(_make(shards, num_batches=8, quarantine=quar))
+        it = _make(shards, num_batches=8, quarantine=quar)
+        pre = []
+        for _ in range(3):
+            next(it)
+            pre.extend(it.last_ids)
+        it2 = _make(shards, num_batches=8, quarantine=quar)
+        it2.load_state_dict(it.state_dict())
+        assert pre + _drain_ids(it2) == control
+
+    def test_quarantine_overflow_hard_fails(self, shards):
+        paths, rb = shards
+        for i in (1, 5, 9):
+            chaos.corrupt_record(paths[0], i, rb)
+        it = _make(shards, num_batches=8,
+                   quarantine=QuarantinePolicy(max_rate=0.02, min_count=2))
+        with pytest.raises(QuarantineOverflowError, match="max_rate"):
+            _drain_ids(it)
+
+    def test_validate_record_hook_quarantines(self, shards):
+        it = _make(shards, num_batches=8,
+                   validate_record=lambda p: p[:8] != np.int64(7).tobytes(),
+                   quarantine=QuarantinePolicy(max_rate=0.5, min_count=64))
+        ids = _drain_ids(it)
+        assert 7 not in ids and it.quarantined == 1
+
+    @pytest.mark.chaos_data
+    def test_drop_shard_recovers_via_reassignment(self, shards):
+        paths, rb = shards
+        mem = tele.MemorySink()
+        bus = tele.TelemetryBus("drop", sinks=[mem])
+        with chaos.DropShard(paths[1], telemetry=bus) as ds:
+            it = _make(shards, num_batches=8, telemetry=bus)
+            ids = _drain_ids(it)
+        assert sorted(ids) == list(range(N_REC))  # nothing lost
+        assert ds.reassigned and it.files.reassigns == 1
+        assert it.files.retries >= 1
+        assert any(e["type"] == "data_stall"
+                   and e["cause"] == "shard_reassign" for e in mem.events)
+        for e in mem.events:
+            tele.validate_event(e)
+
+    @pytest.mark.chaos_data
+    def test_dead_shard_raises_instead_of_hanging(self, shards):
+        paths, rb = shards
+        with chaos.DropShard(paths[1], fail_after_reassign=True):
+            it = _make(shards, num_batches=8)
+            with pytest.raises(DataShardError, match="re-assigned"):
+                _drain_ids(it)
+
+    @pytest.mark.chaos_data
+    def test_slow_read_surfaces_data_stall(self, shards):
+        paths, rb = shards
+        mem = tele.MemorySink()
+        bus = tele.TelemetryBus("slow", sinks=[mem])
+        with chaos.SlowShardRead(paths[0], delay=0.05, times=2):
+            it = _make(shards, num_batches=2, slow_read_threshold=0.01,
+                       telemetry=bus)
+            for _ in it:
+                pass
+        ev = [e for e in mem.events if e["type"] == "data_stall"]
+        assert ev and all(e["cause"] == "slow_read" for e in ev)
+        assert it.files.slow_reads >= 1
+
+    @pytest.mark.chaos_data
+    def test_read_timeout_breaks_straggler_wait(self, shards):
+        paths, rb = shards
+        with chaos.SlowShardRead(paths[0], delay=0.6, times=1):
+            it = _make(shards, num_batches=1, read_timeout=0.1)
+            next(it)  # must return well before the 0.6s stall ends
+        assert it.files.retries >= 1
+
+
+# ------------------------------------------------------- prefetcher
+
+
+class TestAsyncPrefetcher:
+    def test_backpressure_bounds_production(self, shards):
+        produced = []
+        src = _make(shards, num_batches=8,
+                    on_ids=lambda i, ids: produced.append(i))
+        pf = AsyncPrefetcher(src, depth=2)
+        import time
+
+        deadline = time.monotonic() + 2.0
+        while len(produced) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.1)  # would balloon here without backpressure
+        assert len(produced) <= 4  # depth + in-flight, never the full 8
+        pf.close()
+
+    def test_consumed_state_excludes_in_flight(self, shards):
+        control = _drain_ids(_make(shards, num_batches=8))
+        src = _make(shards, num_batches=8)
+        pf = AsyncPrefetcher(src, depth=2)
+        for _ in range(3):
+            next(pf)
+        st = pf.state_dict()  # worker may be 2 batches ahead
+        pf.close()
+        assert st["batches_consumed"] == 3
+        it2 = _make(shards, num_batches=8)
+        it2.load_state_dict(st)
+        assert _drain_ids(it2) == control[3 * BATCH:]
+
+    def test_wait_accounting_and_stall_event(self, shards):
+        mem = tele.MemorySink()
+        bus = tele.TelemetryBus("pf", sinks=[mem])
+
+        class Slow:
+            def __init__(self):
+                self.n = 0
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                import time
+
+                self.n += 1
+                if self.n > 3:
+                    raise StopIteration
+                time.sleep(0.05)
+                return self.n
+
+        pf = AsyncPrefetcher(Slow(), depth=2, stall_threshold_s=0.01,
+                             telemetry=bus)
+        assert list(pf) == [1, 2, 3]
+        assert pf.take_wait() > 0 and pf.take_wait() == 0.0
+        assert pf.stalls >= 1
+        ev = [e for e in mem.events if e["type"] == "data_stall"]
+        assert ev and all(e["cause"] == "queue_dry" for e in ev)
+        pf.close()
+
+    def test_loader_death_is_loud(self, shards):
+        class Dying:
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                raise RuntimeError("decode exploded")
+
+        pf = AsyncPrefetcher(Dying())
+        with pytest.raises(DataLoaderError, match="decode exploded"):
+            next(pf)
+        pf.close()
+
+    def test_non_checkpointable_source_refuses_state(self):
+        pf = AsyncPrefetcher(iter([1, 2]), start=False)
+        with pytest.raises(TypeError, match="not checkpointable"):
+            pf.state_dict()
+        pf.close()
+
+    def test_wraps_native_loader_as_fast_path(self, tmp_path):
+        """The dataloader.cpp decision (docs/data.md): the native loader
+        binds behind the prefetcher as the non-checkpointable fast
+        path."""
+        from apex_tpu.data import NativeRecordLoader, native_available, \
+            write_records
+
+        if not native_available():
+            pytest.skip("native toolchain unavailable")
+        recs = np.arange(32 * 8, dtype=np.uint8).reshape(32, 8)
+        p = str(tmp_path / "raw.bin")
+        write_records(p, recs)
+        with NativeRecordLoader([p], 8, 4, shuffle=False) as ld:
+            pf = AsyncPrefetcher(ld, depth=2)
+            batch = next(pf)
+            assert batch.shape == (4, 8)
+            with pytest.raises(TypeError, match="not checkpointable"):
+                pf.state_dict()
+            pf._halt()
+
+
+# --------------------------------------- train-loop / elastic integration
+
+
+def _tiny_step_fn():
+    """Deterministic fp32 step whose trajectory encodes the batch
+    content: exact-integer sums keep the comparison bitwise."""
+
+    @jax.jit
+    def bump(w, b):
+        return w + jnp.sum(b.astype(jnp.float32)) / 1024.0
+
+    def step_fn(state, batch):
+        return {"w": bump(state["w"], jnp.asarray(batch))}, None
+
+    return step_fn
+
+
+def _data_elastic_build():
+    """Synthetic elastic workload fed by real batches: replicated param
+    folded from the batch bytes + per-rank opt partitions (total flat
+    size 256 survives any 4->2->1 reshard)."""
+
+    @jax.jit
+    def bump(w, b):
+        return w + jnp.sum(b.astype(jnp.float32)) / 1024.0
+
+    def build(devices):
+        n = len(devices)
+        params = {"w": jnp.zeros((16,), jnp.float32)}
+        opt = {"m": jnp.zeros((n, 256 // n), jnp.float32)}
+
+        def step_fn(state, batch):
+            p, o = state
+            return ({"w": bump(p["w"], jnp.asarray(batch))}, o), None
+
+        return step_fn, (params, opt), (P(), P("data"))
+
+    return build
+
+
+class TestLoopIntegration:
+    def test_plain_generator_rejected_with_checkpointing(self, shards,
+                                                         tmp_path):
+        def gen():
+            while True:
+                yield np.zeros((BATCH, PAYLOAD), np.uint8)
+
+        with pytest.raises(TypeError, match="not checkpointable"):
+            run_resilient_training(_tiny_step_fn(), {"w": jnp.zeros(4)},
+                                   data_iter=gen(),
+                                   ckpt_dir=str(tmp_path / "ck"),
+                                   save_every=1)
+        # without checkpointing a plain iterator is fine (old behavior)
+        r = run_resilient_training(
+            _tiny_step_fn(), {"w": jnp.zeros(4)},
+            data_iter=iter([np.ones((BATCH, PAYLOAD), np.uint8)] * 2))
+        assert r.step == 2
+
+    def test_batches_and_data_iter_are_exclusive(self, shards):
+        it = _make(shards, num_batches=1)
+        with pytest.raises(ValueError, match="not both"):
+            run_resilient_training(_tiny_step_fn(), {"w": jnp.zeros(4)},
+                                   [1, 2], data_iter=it)
+        with pytest.raises(ValueError, match="batches or data_iter"):
+            run_resilient_training(_tiny_step_fn(), {"w": jnp.zeros(4)})
+
+    @pytest.mark.chaos
+    @pytest.mark.chaos_data
+    def test_sigterm_grace_exactly_once_resume(self, shards,
+                                               chaos_ckpt_dir):
+        """Kill (real SIGTERM, grace path) mid-run; resume from the
+        checkpoint — consumed sample-id stream and the fp32 trajectory
+        are bitwise the uninterrupted run's."""
+        control_it = _make(shards, num_batches=6)
+        control_ids = _drain_ids(control_it)
+        w = {"w": jnp.zeros((4,), jnp.float32)}
+        step_fn = _tiny_step_fn()
+        for i in range(6):
+            w, _ = step_fn(w, np.stack(
+                [np.frombuffer(
+                    control_it.files.read(r)[:PAYLOAD], np.uint8)
+                 for r in control_ids[i * BATCH:(i + 1) * BATCH]]))
+        control_w = np.asarray(w["w"])
+
+        seen = []
+        it = _make(shards, num_batches=6,
+                   on_ids=lambda i, ids: seen.extend(ids))
+        with res.GracePeriodHandler() as h:
+            pre = chaos.SimulatedPreemption(3, handler=h)
+            r1 = run_resilient_training(
+                step_fn, {"w": jnp.zeros((4,), jnp.float32)},
+                data_iter=it, ckpt_dir=str(chaos_ckpt_dir), save_every=1,
+                handler=h, on_step=pre.poll)
+        assert r1.preempted and r1.step == 3
+        assert seen == control_ids[:3 * BATCH]
+
+        state2, step = res.restore_resilient(
+            str(chaos_ckpt_dir), {"w": jnp.zeros((4,), jnp.float32)})
+        assert step == 3
+        it2 = _make(shards, num_batches=6,
+                    on_ids=lambda i, ids: seen.extend(ids))
+        it2.load_state_dict(ckpt.load_data_state(str(chaos_ckpt_dir),
+                                                 step=step))
+        r2 = run_resilient_training(step_fn, state2, data_iter=it2,
+                                    ckpt_dir=str(chaos_ckpt_dir),
+                                    save_every=1, start_step=step)
+        assert r2.step == 6
+        # no duplicates, no drops — and the trajectory agrees bitwise
+        assert seen == control_ids
+        np.testing.assert_array_equal(np.asarray(r2.state["w"]), control_w)
+
+    @pytest.mark.chaos
+    @pytest.mark.chaos_data
+    @pytest.mark.chaos_mesh
+    def test_device_loss_elastic_dp4_to_dp2_exactly_once(self, shards,
+                                                         tmp_path):
+        """Hard-kill path: DeviceLossError at step 3, elastic rebuild
+        dp=4→dp=2, model AND iterator restored from one manifest —
+        every produced batch matches the control bitwise and the final
+        params equal the uninterrupted run's."""
+        control_ids = _drain_ids(_make(shards, num_batches=6))
+        per_batch = {i: control_ids[i * BATCH:(i + 1) * BATCH]
+                     for i in range(6)}
+        build = _data_elastic_build()
+        step_fn, state, _ = build(jax.devices()[:4])
+        it = _make(shards, num_batches=6)
+        for b in it:
+            state, _ = step_fn(state, b)
+        control_w = np.asarray(state[0]["w"])
+
+        produced = {}
+        it2 = _make(shards, num_batches=6,
+                    on_ids=lambda i, ids: produced.setdefault(i, [])
+                    .append(ids))
+        dl = chaos.DeviceLoss(at_step=3, device_ids=jax.devices()[2:4])
+        result = res.run_elastic_training(
+            _data_elastic_build(), jax.devices()[:4], data_iter=it2,
+            ckpt_dir=str(tmp_path / "ck"), save_every=1,
+            on_step=dl.poll, max_restarts=2)
+        assert result.restarts == 1 and len(result.devices) == 2
+        assert result.step == 6
+        assert sorted(produced) == list(range(6))
+        for i, reps in produced.items():
+            for ids in reps:
+                assert ids == per_batch[i], (i, ids)
+        np.testing.assert_array_equal(
+            np.asarray(result.state[0]["w"]), control_w)
+
+    @pytest.mark.chaos_data
+    def test_elastic_rejects_plain_generator(self, shards, tmp_path):
+        def gen():
+            yield np.zeros((BATCH, PAYLOAD), np.uint8)
+
+        with pytest.raises(TypeError, match="not checkpointable"):
+            res.run_elastic_training(_data_elastic_build(),
+                                     jax.devices()[:2], data_iter=gen(),
+                                     ckpt_dir=str(tmp_path / "ck"))
+
+    @pytest.mark.chaos
+    @pytest.mark.chaos_data
+    def test_loader_death_flushes_postmortem(self, shards, tmp_path):
+        """A dying loader thread surfaces as DataLoaderError at the
+        loop's next fetch AND leaves a postmortem (the loop's crash
+        path)."""
+        paths, rb = shards
+
+        class DieAfter:
+            def __init__(self, src, n):
+                self.src, self.n = src, n
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                if self.src.batches_consumed >= self.n:
+                    raise OSError("shard backend gone")
+                return next(self.src)
+
+            def state_dict(self):
+                return self.src.state_dict()
+
+            def load_state_dict(self, s):
+                self.src.load_state_dict(s)
+
+        mem = tele.MemorySink()
+        bus = tele.TelemetryBus(
+            "loaderdeath",
+            sinks=[tele.JsonlSink(str(tmp_path / "s.jsonl")), mem],
+            postmortem_dir=str(tmp_path))
+        pf = AsyncPrefetcher(DieAfter(_make(shards, num_batches=6), 2),
+                             depth=1, telemetry=bus)
+        with pytest.raises(DataLoaderError, match="shard backend gone"):
+            run_resilient_training(_tiny_step_fn(),
+                                   {"w": jnp.zeros((4,), jnp.float32)},
+                                   data_iter=pf,
+                                   ckpt_dir=str(tmp_path / "ck"),
+                                   save_every=1, telemetry=bus)
+        pf.close()
+        bus.close()
+        pms = [f for f in os.listdir(tmp_path)
+               if f.startswith("postmortem_")]
+        assert len(pms) == 1
+        events = tele.load_jsonl(str(tmp_path / pms[0]))
+        assert tele.validate_events(events) == len(events)
+        assert events[0]["reason"] == "DataLoaderError"
+
+
+# ------------------------------------------------ flagship golden replay
+
+
+@pytest.mark.chaos
+@pytest.mark.chaos_data
+@pytest.mark.chaos_mesh
+def test_flagship_device_loss_data_resume_matches_golden(tmp_path):
+    """ISSUE 7 acceptance: the toy ZeRO flagship fed by the record
+    pipeline loses 4 of 8 devices at step 3, rebuilds on the survivor
+    submesh, restores model + iterator position from one manifest, and
+    reproduces the committed ``gpt1p3b_toy_data`` fp32-hex golden
+    trajectory (8-device prefix bitwise; resumed-on-submesh steps ≤ 1
+    bf16 ulp — the same bound as the compute-plane golden arc)."""
+    from tests.L1.common.harness import (
+        load_baseline,
+        write_toy_token_shards,
+    )
+
+    golden = load_baseline("gpt1p3b_toy_data")
+    assert golden is not None and len(golden) == 6
+
+    from apex_tpu.data import ShardedRecordIterator
+    from apex_tpu.transformer.testing import (
+        flagship_elastic_build,
+        gpt1p3b_config,
+    )
+
+    cfg = gpt1p3b_config(num_layers=2, hidden_size=256,
+                         num_attention_heads=2, vocab_size=512,
+                         max_position_embeddings=32)
+    paths, rb, decode = write_toy_token_shards(str(tmp_path))
+    it = ShardedRecordIterator(paths, rb, 8, checksummed=True,
+                               shuffle_window=16, seed=5, num_batches=6,
+                               decode=decode)
+    losses = []
+    build = flagship_elastic_build(cfg, plan="bf16_fit", lr=1e-3,
+                                   on_loss=losses.append)
+    dl = chaos.DeviceLoss(at_step=3, device_ids=jax.devices()[4:8])
+    result = res.run_elastic_training(
+        build, jax.devices()[:8], data_iter=it,
+        ckpt_dir=str(tmp_path / "ck"), save_every=1, on_step=dl.poll,
+        max_restarts=2)
+    assert result.restarts == 1 and len(result.devices) == 4
+    assert result.step == 6 and len(losses) == 7
+
+    def ulp(a, b):
+        ba = np.asarray(a, jnp.bfloat16.dtype).view(np.uint16)
+        bb = np.asarray(b, jnp.bfloat16.dtype).view(np.uint16)
+        return int(np.abs(ba.astype(np.int64) - bb.astype(np.int64)).max())
+
+    np.testing.assert_array_equal(losses[:3], golden[:3])
+    assert max(ulp(np.float32(got), np.float32(want))
+               for got, want in zip(losses[3:], golden[2:])) <= 1, (
+        losses, golden)

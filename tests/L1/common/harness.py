@@ -203,6 +203,63 @@ def run_flagship_trajectory(steps: int = 8, seed: int = 0) -> List[float]:
     return losses
 
 
+def write_toy_token_shards(work_dir: str, *, seq: int = 32,
+                           vocab: int = 512, n_per_shard: int = 32,
+                           n_shards: int = 2):
+    """The deterministic checksummed token dataset of the data-pipeline
+    golden cell (ISSUE 7): ``n_shards`` files of ``n_per_shard`` uint32
+    token records (seq+1 ids each), seeded so every regeneration is
+    byte-identical.  Returns ``(paths, record_bytes, decode)`` with
+    ``decode`` mapping a payload matrix to (tokens, labels) jnp arrays."""
+    from apex_tpu.data import write_checksummed_records
+
+    rng = np.random.RandomState(41)
+    paths, rb = [], None
+    for s in range(n_shards):
+        toks = rng.randint(0, vocab,
+                           size=(n_per_shard, seq + 1)).astype(np.uint32)
+        p = os.path.join(work_dir, f"tokens_{s}.bin")
+        rb = write_checksummed_records(
+            p, toks.view(np.uint8).reshape(n_per_shard, -1))
+        paths.append(p)
+
+    def decode(mat):
+        ids = np.ascontiguousarray(mat).view(np.uint32).reshape(
+            mat.shape[0], seq + 1).astype(np.int32)
+        ids = ids % vocab
+        return jnp.asarray(ids[:, :-1]), jnp.asarray(ids[:, 1:])
+
+    return paths, rb, decode
+
+
+def run_flagship_data_trajectory(work_dir: str,
+                                 steps: int = 6) -> List[float]:
+    """Per-step losses of the toy ZeRO flagship fed by the
+    fault-tolerant record pipeline (ShardedRecordIterator over the
+    :func:`write_toy_token_shards` dataset) — the golden cell the
+    exactly-once kill/resume tests replay against (ISSUE 7)."""
+    from apex_tpu.data import ShardedRecordIterator
+    from apex_tpu.transformer.testing import (
+        build_flagship_train_step, gpt1p3b_config)
+
+    cfg = gpt1p3b_config(num_layers=2, hidden_size=256,
+                         num_attention_heads=2, vocab_size=512,
+                         max_position_embeddings=32)
+    paths, rb, decode = write_toy_token_shards(work_dir)
+    it = ShardedRecordIterator(paths, rb, 8, checksummed=True,
+                               shuffle_window=16, seed=5,
+                               num_batches=steps, decode=decode)
+    fs = build_flagship_train_step(cfg, plan="bf16_fit", lr=1e-3,
+                                   devices=jax.devices()[:8],
+                                   seed=0, donate=False)
+    p, s = fs.params, fs.opt_state
+    losses = []
+    for tokens, labels in it:
+        p, s, loss = fs.step(p, s, tokens, labels)
+        losses.append(float(loss))
+    return losses
+
+
 def run_bert_trajectory(steps: int = 6, seed: int = 0) -> List[float]:
     """Per-step losses of a toy BERT MLM run over PACKED varlen inputs
     (segment ids + per-segment positions) through the flash path — the

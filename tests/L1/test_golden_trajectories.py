@@ -86,3 +86,13 @@ def test_golden_trajectory_gpt1p3b_toy():
     over the emulated mesh) at toy depth — covers the gpt1p3b bench
     path end-to-end (ISSUE 2 satellite)."""
     _check("gpt1p3b_toy_zero", run_flagship_trajectory(steps=6))
+
+
+def test_golden_trajectory_gpt1p3b_toy_data(tmp_path):
+    """The toy flagship fed by the fault-tolerant record pipeline
+    (deterministic checksummed shards → ShardedRecordIterator) — the
+    golden the ISSUE 7 exactly-once kill/resume tests replay against:
+    any drift here means the data stream, not just the step, changed."""
+    from tests.L1.common.harness import run_flagship_data_trajectory
+
+    _check("gpt1p3b_toy_data", run_flagship_data_trajectory(str(tmp_path)))
