@@ -635,19 +635,24 @@ def _seed_spec_arg(dropout_rate, dropout_seed):
 # forces one (the bench's fast-vs-generic baseline).
 # ---------------------------------------------------------------------------
 
-_ROUTE_OVERRIDE = {"fwd": None, "bwd": None}
+_ROUTE_OVERRIDE = {"fwd": None, "bwd": None, "decode": None}
 
 
 @contextlib.contextmanager
-def routing_override(fwd=None, bwd=None):
-    """Force the fwd/bwd kernel route inside the block (trace-time
-    effect; use around ``jax.jit`` tracing, e.g. the bench's forced
-    generic-grid baseline).  Values: fwd ∈ {"varlen", "tiles",
-    "stream_skip", "stream", "xla"}, bwd ∈ {"tiles", "grid_skip",
-    "grid", "xla"}.  A forced Pallas route still requires the shape to
-    be Pallas-compilable (``_pallas_ok``)."""
+def routing_override(fwd=None, bwd=None, decode=None):
+    """Force the fwd/bwd/decode kernel route inside the block
+    (trace-time effect; use around ``jax.jit`` tracing, e.g. the
+    bench's forced generic-grid baseline).  Values: fwd ∈ {"varlen",
+    "tiles", "stream_skip", "stream", "xla"}, bwd ∈ {"tiles",
+    "grid_skip", "grid", "xla"}, decode ∈ {"decode", "xla"}.  A forced
+    Pallas fwd/bwd route still requires the shape to be
+    Pallas-compilable (``_pallas_ok``); a forced "decode" route only
+    requires the *shape* gate (``_decode_shape_ok``), not the TPU
+    backend — off-TPU it runs the kernel in interpret mode, which is
+    how the serving parity tests A/B the decode kernel against the
+    generic paged-XLA baseline on identical pages."""
     prev = dict(_ROUTE_OVERRIDE)
-    _ROUTE_OVERRIDE.update(fwd=fwd, bwd=bwd)
+    _ROUTE_OVERRIDE.update(fwd=fwd, bwd=bwd, decode=decode)
     try:
         yield
     finally:
@@ -2132,6 +2137,237 @@ def flash_attention_varlen(
                         segment_ids=(seg_q, seg_k), scale=scale,
                         block_q=block_q, block_k=block_k)
     return jnp.moveaxis(o, 0, 1)
+
+
+# ---------------------------------------------------------------------------
+# Flash decode over a paged KV cache (r8, serving path).
+#
+# Training kernels above see one contiguous [bh, s, d] KV per call; the
+# serving engine instead keeps every request's KV in fixed-size PAGES
+# of a shared preallocated pool (apex_tpu.serving.kv_cache), so a
+# request's cache is a *page list*, not a slab.  The decode kernel
+# consumes that layout directly: the page table rides in as a
+# scalar-prefetch operand and DRIVES THE BLOCK INDEX MAP — grid step
+# (b, h, p) DMAs pool page ``page_table[b, p]`` into VMEM, so the
+# gather that the generic XLA baseline materialises in HBM never
+# happens.  Per-request raggedness is the same trick as the varlen
+# block-skip index: the k-loop (here the page grid dimension) is
+# bounded by the request's page count — pages past ``kv_len`` are
+# predicated off with ``pl.when`` (and, because table rows pad with
+# page 0, their repeated block index elides the dead DMAs too).  The
+# online-softmax carry lives in VMEM scratch across the page steps of
+# one (b, h) cell (the TPU grid is sequential, innermost-last), exactly
+# like the fused backward's persistent dq accumulator.
+# ---------------------------------------------------------------------------
+
+
+def _make_decode_kernel(*, scale, page_size, q_len, d):
+    """Decode forward: grid (b, h, p_max); scalar-prefetch operands
+    (page_table [b, p_max], kv_len [b]).  Queries are the LAST ``q_len``
+    positions of the request's ``kv_len``-token cache (their own k/v
+    already appended), so row i's causal limit is column
+    ``kv_len - q_len + i``."""
+
+    def kernel(pt_ref, kl_ref, q_ref, k_ref, v_ref, o_ref,
+               m_ref, l_ref, acc_ref):
+        b_idx = pl.program_id(0)
+        p = pl.program_id(2)
+        n_p = pl.num_programs(2)
+        kv = kl_ref[b_idx]
+        pages_used = (kv + page_size - 1) // page_size
+
+        @pl.when(p == 0)
+        def _():
+            m_ref[...] = jnp.full((q_len, 1), _NEG_INF, jnp.float32)
+            l_ref[...] = jnp.zeros((q_len, 1), jnp.float32)
+            acc_ref[...] = jnp.zeros((q_len, d), jnp.float32)
+
+        @pl.when(p < pages_used)
+        def _():
+            q = q_ref[0, 0]          # [q_len, d]
+            k = k_ref[0, :, 0, :]    # [page_size, d]
+            v = v_ref[0, :, 0, :]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = p * page_size + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            # one mask does both jobs: the causal limit for the q_len
+            # tail AND the kv_len cutoff (row i's limit kv - q_len + i
+            # is < kv, so garbage past the ragged end never scores)
+            s = jnp.where(cols <= kv - q_len + rows, s, _NEG_INF)
+            m_prev = m_ref[...]
+            m_new = jnp.maximum(m_prev,
+                                jnp.max(s, axis=-1, keepdims=True))
+            pexp = _masked_exp(s, m_new)
+            # a page whose every column is masked for some row leaves
+            # that row's m at -inf: guard the rescale like _merge_parts
+            alpha = jnp.where(m_prev <= _NEG_INF / 2, 0.0,
+                              jnp.exp(m_prev - m_new))
+            l_ref[...] = alpha * l_ref[...] + jnp.sum(pexp, axis=-1,
+                                                      keepdims=True)
+            acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+                pexp.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[...] = m_new
+
+        @pl.when(p == n_p - 1)
+        def _():
+            l = l_ref[...]
+            l_safe = jnp.where(l == 0, 1.0, l)
+            o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+    return kernel
+
+
+def _flash_decode_pallas(q, k_pages, v_pages, page_table, kv_len, scale):
+    """q [b, h, q_len, d]; k_pages/v_pages [n_pages, page_size, h, d];
+    page_table [b, p_max] int32 (rows padded with page 0); kv_len [b].
+    Returns o [b, h, q_len, d]."""
+    b, h, q_len, d = q.shape
+    page_size = k_pages.shape[1]
+    p_max = page_table.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, p_max),
+        in_specs=[
+            pl.BlockSpec((1, 1, q_len, d),
+                         lambda bi, hi, p, pt, kl: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, page_size, 1, d),
+                         lambda bi, hi, p, pt, kl: (pt[bi, p], 0, hi, 0)),
+            pl.BlockSpec((1, page_size, 1, d),
+                         lambda bi, hi, p, pt, kl: (pt[bi, p], 0, hi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, q_len, d),
+                               lambda bi, hi, p, pt, kl: (bi, hi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((q_len, 1), jnp.float32),
+            pltpu.VMEM((q_len, 1), jnp.float32),
+            pltpu.VMEM((q_len, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        _make_decode_kernel(scale=scale, page_size=page_size,
+                            q_len=q_len, d=d),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, q_len, d), q.dtype),
+        interpret=use_interpret(),
+    )(page_table.astype(jnp.int32), kv_len.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+def _paged_attention_xla(q, k_pages, v_pages, page_table, kv_len, scale):
+    """Generic baseline: gather the page list into a contiguous
+    [b, p_max*page_size, h, d] KV view in HBM, then plain masked
+    attention in fp32 — identical math to the kernel, with the
+    materialised gather the kernel exists to avoid.  The decode
+    route's ``routing_override`` escape hatch and the parity sweep's
+    reference."""
+    b, h, q_len, d = q.shape
+    page_size = k_pages.shape[1]
+    p_max = page_table.shape[1]
+    kc = k_pages[page_table]         # [b, p_max, page_size, h, d]
+    vc = v_pages[page_table]
+    kc = kc.reshape(b, p_max * page_size, h, d)
+    vc = vc.reshape(b, p_max * page_size, h, d)
+    s = jnp.einsum("bhqd,bkhd->bhqk", q.astype(jnp.float32),
+                   kc.astype(jnp.float32)) * scale
+    rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+    cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+    limit = (kv_len.astype(jnp.int32) - q_len)[:, None, None, None] + rows
+    s = jnp.where(cols <= limit, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = _masked_exp(s, m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bkhd->bhqd", p, vc.astype(jnp.float32))
+    return (o / jnp.where(l == 0, 1.0, l)).astype(q.dtype)
+
+
+def _decode_shape_ok(q, k_pages):
+    """Shape-only gate for the decode kernel (backend-independent —
+    interpret mode runs it anywhere): the page's sublane extent must be
+    a whole number of native tiles for the POOL dtype (the same Mosaic
+    grain rule ``_pallas_ok`` applies to block_q/block_k: 8 rows at
+    fp32, 16 at bf16, 32 at one-byte dtypes), and pool/head dims must
+    agree."""
+    b, h, q_len, d = q.shape
+    n_pages, page_size, hp, dp = k_pages.shape
+    grain = 32 // max(1, jnp.dtype(k_pages.dtype).itemsize)
+    return (hp == h and dp == d and page_size % grain == 0
+            and q_len >= 1)
+
+
+def _decode_tpu_ok(q):
+    """The EXTRA constraint auto-routing applies before picking the
+    kernel on a real TPU: the head dim is the block's lane extent and
+    must be a whole number of 128-lane tiles for Mosaic to lower the
+    (1, page_size, 1, d) K/V blocks.  Conservative by design — the
+    flagship geometry (d=128) passes; a forced "decode" skips this
+    (interpret mode has no lane constraint, and on-TPU forcing is the
+    caller's explicit opt-in, same contract as the fwd/bwd tables)."""
+    return q.shape[-1] % LANE == 0
+
+
+def flash_decode_route(q, k_pages=None) -> str:
+    """The route :func:`flash_decode` takes for these operands (arrays
+    or ShapeDtypeStructs): "decode" (the paged Pallas kernel) or "xla"
+    (the gather-based generic baseline).  The PR 5 routing-table rules
+    extended to the serving path: auto routing picks the kernel only on
+    TPU with an aligned page shape; ``routing_override(decode=...)``
+    forces either side — a forced "decode" skips the backend check (it
+    runs in interpret mode off-TPU), a forced "xla" A/Bs the generic
+    baseline on identical pages."""
+    forced = _ROUTE_OVERRIDE["decode"]
+    if forced is not None:
+        if forced == "xla":
+            return "xla"
+        if k_pages is not None and not _decode_shape_ok(q, k_pages):
+            return "xla"
+        return "decode"
+    if jax.default_backend() != "tpu":
+        return "xla"
+    if k_pages is not None and not _decode_shape_ok(q, k_pages):
+        return "xla"
+    if not _decode_tpu_ok(q):
+        return "xla"
+    return "decode"
+
+
+def flash_decode(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray, v_pages: jnp.ndarray,
+    page_table: jnp.ndarray, kv_len: jnp.ndarray,
+    *,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Decode-mode attention against a paged KV cache.
+
+    ``q`` [b, h, q_len, d]: the last ``q_len`` positions of each
+    request (q_len is 1 for plain autoregressive decode, >1 for
+    speculative/chunked decode).  ``k_pages``/``v_pages``
+    [n_pages, page_size, h, d]: the shared page pool.  ``page_table``
+    [b, p_max] int32: each request's page list in cache order, rows
+    padded with page 0 (the pool's reserved scratch page — see
+    ``apex_tpu.serving.kv_cache``).  ``kv_len`` [b]: valid tokens per
+    request, INCLUDING the ``q_len`` query tokens, whose k/v must
+    already be appended to the cache; ``kv_len >= q_len`` is the
+    caller's contract.  Decode is causal by construction: query row i
+    sees columns ``[0, kv_len - q_len + i]``.
+
+    Inference-only (no VJP — the serving path never differentiates);
+    routing per :func:`flash_decode_route`, forceable via
+    ``routing_override(decode=...)``.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    kv_len = jnp.asarray(kv_len, jnp.int32)
+    page_table = jnp.asarray(page_table, jnp.int32)
+    if flash_decode_route(q, k_pages) == "decode":
+        return _flash_decode_pallas(q, k_pages, v_pages, page_table,
+                                    kv_len, float(scale))
+    return _paged_attention_xla(q, k_pages, v_pages, page_table,
+                                kv_len, float(scale))
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,59 @@
+"""Inference serving engine (ISSUE 8): flash-decode kernel, paged
+KV-cache, and continuous-batching scheduler.
+
+Three composable layers, bottom-up:
+
+* :func:`apex_tpu.ops.flash_decode` — decode-mode attention over a
+  paged KV cache (the kernel lives with its training siblings in
+  ``ops/attention.py``; routing via
+  :func:`~apex_tpu.ops.flash_decode_route`, forceable with
+  ``routing_override(decode=...)``).
+* :class:`PagedKVCache` — fixed-size pages in a preallocated HBM pool,
+  per-request page lists, deterministic lowest-first allocation,
+  :meth:`~PagedKVCache.defrag` compaction.
+* :class:`ContinuousBatchingScheduler` + :class:`ServingEngine` —
+  admission/growth/preemption/retirement policy, and the engine that
+  turns it into exactly two compiled device functions (fixed-shape
+  prefill and decode).
+
+See docs/serving.md for the page-table layout, the admission policy,
+decode routing, and the bench methodology.
+"""
+
+from apex_tpu.serving.engine import (  # noqa: F401
+    ServingEngine,
+    SimClock,
+    poisson_trace,
+)
+from apex_tpu.serving.kv_cache import (  # noqa: F401
+    PagedKVCache,
+    PagePoolExhausted,
+)
+from apex_tpu.serving.model import (  # noqa: F401
+    PagedDecoder,
+    ServingModelConfig,
+    init_params,
+)
+from apex_tpu.serving.scheduler import (  # noqa: F401
+    FINISHED,
+    RUNNING,
+    WAITING,
+    ContinuousBatchingScheduler,
+    Request,
+)
+
+__all__ = [
+    "ServingEngine",
+    "SimClock",
+    "poisson_trace",
+    "PagedKVCache",
+    "PagePoolExhausted",
+    "PagedDecoder",
+    "ServingModelConfig",
+    "init_params",
+    "ContinuousBatchingScheduler",
+    "Request",
+    "WAITING",
+    "RUNNING",
+    "FINISHED",
+]

@@ -1,0 +1,396 @@
+"""The serving engine: the device-facing half of continuous batching.
+
+:class:`ServingEngine` turns the :class:`~apex_tpu.serving.scheduler.
+ContinuousBatchingScheduler`'s host-side decisions into exactly two
+compiled device functions, each traced ONCE for the engine's lifetime:
+
+* **prefill** — a fixed-width packed row (``[1, prefill_budget]``
+  tokens + segment ids + per-segment positions) through
+  :meth:`~apex_tpu.serving.model.PagedDecoder.prefill`, returning the
+  greedy next-token per position and per-layer K/V, which the engine
+  scatters into the request's freshly allocated pages.
+* **decode** — a fixed-width ``[max_batch]`` step through
+  :meth:`~apex_tpu.serving.model.PagedDecoder.decode`: append each
+  row's newest token's K/V into its current page, attend over the
+  row's page list via :func:`~apex_tpu.ops.flash_decode`, sample
+  greedily.  Idle rows are pointed at the scratch page and ignored.
+
+Admitting, retiring, growing or preempting requests between steps
+never changes a device shape, so the serving lifetime sees exactly two
+XLA compilations.
+
+**The isolation contract (and why prefill is one request per row).**
+The acceptance bar for this engine is bitwise: batched continuous
+decoding must produce exactly the tokens sequential one-request-at-a-
+time decoding produces.  Decode is row-wise by construction, but a
+packed prefill row holding SEVERAL segments is not offset-invariant —
+the attention contraction reduces over the packed axis, and XLA's
+blocked reduction groups differently depending on where in the row a
+segment starts (measured: a segment at offset 17 differs from offset 0
+in the last ulp, enough to flip a greedy tie).  So the engine prefills
+each admitted request in its OWN fixed-width row at offset 0: the
+varlen packed machinery (segment ids mask the padding) with exactly
+one segment per row.  Admission still batches — the scheduler admits
+many requests per step — but each prefill launch serves one request.
+The multi-segment form of :meth:`PagedDecoder.prefill` remains
+available for throughput-over-isolation deployments; the engine does
+not use it (docs/serving.md, "Prefill isolation").
+
+Telemetry: every lifecycle edge lands on the PR 4 bus as one of the
+three serving event types — ``request_admit``, ``request_retire``
+(with per-request TTFT/TPOT), ``decode_step`` (batch width, tokens,
+page-pool occupancy) — so ``python -m apex_tpu.telemetry summarize``
+renders a serving line and the bench's stream is schema-validated by
+the existing ``validate`` CLI.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.serving.kv_cache import PagedKVCache
+from apex_tpu.serving.model import (PagedDecoder, ServingModelConfig,
+                                    init_params)
+from apex_tpu.serving.scheduler import (WAITING,
+                                        ContinuousBatchingScheduler,
+                                        Request)
+
+
+class SimClock:
+    """Deterministic virtual clock for tests: ``now()`` returns the
+    current virtual time; the engine's step advances it by a fixed
+    tick, so a seeded arrival trace replays bit-identically with no
+    wall-clock in the loop."""
+
+    def __init__(self, tick: float = 1.0):
+        self.t = 0.0
+        self.tick = tick
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self) -> None:
+        self.t += self.tick
+
+
+def poisson_trace(seed: int, n_requests: int, *, rate: float,
+                  prompt_len: Tuple[int, int], max_new: Tuple[int, int],
+                  vocab_size: int,
+                  eos_id: Optional[int] = None) -> List[Request]:
+    """Seeded Poisson arrival trace: exponential inter-arrival gaps at
+    ``rate`` requests/s, uniform prompt lengths and generation budgets.
+    Deterministic in ``seed`` — the serving bench's workload and the
+    scheduler determinism test share this generator."""
+    rng = np.random.RandomState(seed)
+    t = 0.0
+    out: List[Request] = []
+    for rid in range(n_requests):
+        t += float(rng.exponential(1.0 / rate))
+        plen = int(rng.randint(prompt_len[0], prompt_len[1] + 1))
+        out.append(Request(
+            rid=rid,
+            prompt=[int(x) for x in rng.randint(0, vocab_size, plen)],
+            max_new_tokens=int(rng.randint(max_new[0], max_new[1] + 1)),
+            eos_id=eos_id,
+            arrival_t=t,
+        ))
+    return out
+
+
+class ServingEngine:
+    """Continuous-batching inference over a paged KV cache.
+
+    ``num_pages``/``page_size`` size the shared pool;
+    ``prefill_budget`` fixes the packed prefill row width (defaults to
+    ``cfg.max_position``) and bounds prompt+generation per request;
+    ``max_batch`` fixes the decode batch width.  ``telemetry`` is an
+    optional :class:`~apex_tpu.telemetry.TelemetryBus`; ``clock`` an
+    optional ``() -> float`` (tests pass :class:`SimClock` for
+    deterministic timing fields — timing never feeds scheduling
+    decisions, only metrics).
+    """
+
+    def __init__(self, cfg: ServingModelConfig, params=None, *,
+                 num_pages: int, page_size: int = 64,
+                 max_batch: int = 8,
+                 max_pages_per_request: Optional[int] = None,
+                 prefill_budget: Optional[int] = None,
+                 telemetry=None,
+                 clock: Optional[Callable[[], float]] = None,
+                 seed: int = 0):
+        self.cfg = cfg
+        self.params = params if params is not None else init_params(cfg, seed)
+        self.prefill_budget = (cfg.max_position if prefill_budget is None
+                               else prefill_budget)
+        if max_pages_per_request is None:
+            max_pages_per_request = -(-self.prefill_budget // page_size)
+        self.cache = PagedKVCache(
+            num_layers=cfg.num_layers, num_pages=num_pages,
+            page_size=page_size, num_heads=cfg.num_heads,
+            head_dim=cfg.head_dim,
+            max_pages_per_request=max_pages_per_request,
+            dtype=cfg.dtype)
+        self.sched = ContinuousBatchingScheduler(
+            self.cache, max_batch=max_batch,
+            prefill_budget=self.prefill_budget,
+            max_position=cfg.max_position)
+        self.decoder = PagedDecoder(cfg)
+        self.max_batch = max_batch
+        self.telemetry = telemetry
+        self.clock = clock if clock is not None else time.monotonic
+        self._next_rid = 0
+        self.steps = 0
+        self.decode_steps = 0
+        decoder = self.decoder
+
+        def _prefill(params, tokens, seg, positions, last_index):
+            # logits for the last context position only: admission
+            # needs one next-token distribution, not S of them
+            logits, k, v = decoder.prefill(params, tokens, seg,
+                                           positions, last_index)
+            return jnp.argmax(logits[0, 0], axis=-1), k[:, 0], v[:, 0]
+
+        def _decode(params, k_pool, v_pool, tokens, positions,
+                    page_table, kv_len):
+            logits, k_pool, v_pool = decoder.decode(
+                params, k_pool, v_pool, tokens, positions, page_table,
+                kv_len)
+            return jnp.argmax(logits, axis=-1), k_pool, v_pool
+
+        self._prefill_fn = jax.jit(_prefill)
+        # donate the pool buffers on TPU: the decode step would
+        # otherwise hold old + new pool alive across every step (the
+        # CPU backend doesn't implement donation — gating avoids a
+        # warning per test run).  The engine rebinds cache.k/v to the
+        # returned pools immediately, so nothing aliases the donated
+        # buffers.
+        donate = (1, 2) if jax.default_backend() == "tpu" else ()
+        self._decode_fn = jax.jit(_decode, donate_argnums=donate)
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
+               eos_id: Optional[int] = None,
+               arrival_t: Optional[float] = None) -> Request:
+        """Create and queue a request; returns its :class:`Request`
+        handle (tokens accumulate on ``.generated``)."""
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if not prompt:
+            raise ValueError("empty prompt")
+        req = Request(rid=self._next_rid, prompt=list(prompt),
+                      max_new_tokens=max_new_tokens, eos_id=eos_id,
+                      arrival_t=(self.clock() if arrival_t is None
+                                 else arrival_t))
+        self._next_rid += 1
+        self.sched.submit(req)
+        return req
+
+    def submit_request(self, req: Request) -> Request:
+        """Queue a pre-built request (trace replay); rids must be
+        unique per engine."""
+        self._next_rid = max(self._next_rid, req.rid + 1)
+        self.sched.submit(req)
+        return req
+
+    # -- device steps ------------------------------------------------------
+
+    def warmup(self) -> float:
+        """Compile both device shapes before any request arrives (so
+        TTFT never carries jit-compile wall); returns the seconds
+        spent.  The decode warmup donates and rebinds the pool
+        buffers; its zero K/V lands in scratch page 0, which no reader
+        ever sees."""
+        t0 = time.perf_counter()
+        z = jnp.zeros((1, self.prefill_budget), jnp.int32)
+        jax.block_until_ready(self._prefill_fn(
+            self.params, z, z, z, jnp.zeros((), jnp.int32)))
+        b = self.max_batch
+        p_max = self.cache.max_pages_per_request
+        _, wk, wv = self._decode_fn(
+            self.params, self.cache.k, self.cache.v,
+            jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
+            jnp.zeros((b, p_max), jnp.int32), jnp.ones((b,), jnp.int32))
+        self.cache.k, self.cache.v = wk, wv
+        jax.block_until_ready(wk)
+        return time.perf_counter() - t0
+
+    def _prefill_request(self, req: Request) -> None:
+        """One fixed-width prefill for one request: compute K/V for the
+        whole context (prompt + pre-preemption tokens), scatter it into
+        the request's pages, sample the next token."""
+        S = self.prefill_budget
+        ctx = req.context
+        C = len(ctx)
+        ps = self.cache.page_size
+        tokens = np.zeros((1, S), np.int32)
+        tokens[0, :C] = ctx
+        seg = np.zeros((1, S), np.int32)
+        seg[0, :C] = 1
+        positions = np.zeros((1, S), np.int32)
+        positions[0, :C] = np.arange(C)
+        next_tok, k, v = self._prefill_fn(
+            self.params, jnp.asarray(tokens), jnp.asarray(seg),
+            jnp.asarray(positions), jnp.asarray(C - 1, jnp.int32))
+        # packed position t -> (page, in-page offset); padding -> scratch
+        pages = np.zeros((S,), np.int32)
+        offsets = np.zeros((S,), np.int32)
+        idx = np.arange(C)
+        pages[:C] = np.asarray(req.pages, np.int32)[idx // ps]
+        offsets[:C] = idx % ps
+        self.cache.write_tokens(k, v, pages, offsets)
+        req.kv_len = C
+        req.generated.append(int(next_tok))
+        if req.first_token_t is None:
+            req.first_token_t = self.clock()
+
+    def _decode_batch(self, rows: List[Request]) -> None:
+        """One decode step for ``rows`` (≤ max_batch), idle-padded to
+        the fixed batch width."""
+        b = self.max_batch
+        tokens = np.zeros((b,), np.int32)
+        positions = np.zeros((b,), np.int32)
+        kv_len = np.ones((b,), np.int32)
+        for i, req in enumerate(rows):
+            tokens[i] = req.generated[-1]
+            positions[i] = req.seq_len - 1
+            kv_len[i] = req.seq_len
+        page_table = self.cache.page_table(
+            [req.pages for req in rows], rows=b)
+        next_tok, k_pool, v_pool = self._decode_fn(
+            self.params, self.cache.k, self.cache.v,
+            jnp.asarray(tokens), jnp.asarray(positions), page_table,
+            jnp.asarray(kv_len))
+        self.cache.k, self.cache.v = k_pool, v_pool
+        next_tok = np.asarray(next_tok)
+        for i, req in enumerate(rows):
+            req.kv_len = req.seq_len
+            req.generated.append(int(next_tok[i]))
+
+    # -- the engine step ---------------------------------------------------
+
+    def _emit(self, type_: str, **payload) -> None:
+        if self.telemetry is not None:
+            self.telemetry.emit(type_, step=self.steps, **payload)
+
+    def _retire(self, now: float) -> List[Request]:
+        done = self.sched.retire_finished(now)
+        for req in done:
+            n = len(req.generated)
+            ev = dict(rid=req.rid, reason=req.finish_reason,
+                      new_tokens=n, preemptions=req.preemptions)
+            if req.first_token_t is not None:
+                ev["ttft_ms"] = round(
+                    (req.first_token_t - req.arrival_t) * 1e3, 3)
+                if n > 1 and req.finish_t is not None:
+                    ev["tpot_ms"] = round(
+                        (req.finish_t - req.first_token_t) / (n - 1) * 1e3,
+                        3)
+            self._emit("request_retire", **ev)
+        return done
+
+    def step(self) -> bool:
+        """One engine iteration: retire → admit+prefill → retire →
+        grow/preempt → decode.  Returns True if any work was done."""
+        now = self.clock()
+        progress = bool(self._retire(now))
+        admitted = self.sched.admit()
+        for req in admitted:
+            req.admit_t = now
+            ctx_tokens = len(req.context)
+            self._prefill_request(req)
+            self._emit("request_admit", rid=req.rid,
+                       context_tokens=ctx_tokens,
+                       pages=len(req.pages),
+                       preemptions=req.preemptions)
+            progress = True
+        # a request whose budget was a single token is done at prefill
+        progress = bool(self._retire(now)) or progress
+        evicted: List[Request] = []
+        if self.sched.running:
+            evicted = self.sched.ensure_decode_capacity()
+        rows = list(self.sched.running)
+        if rows:
+            t0 = self.clock()
+            self._decode_batch(rows)
+            self.decode_steps += 1
+            # evictions ride the decode_step payload (a preempted
+            # request is also visible later: its re-admission's
+            # request_admit carries preemptions > 0)
+            self._emit("decode_step", batch=len(rows),
+                       new_tokens=len(rows),
+                       pool_used=self.cache.pages_used,
+                       pool_pages=self.cache.num_pages - 1,
+                       evicted=[r.rid for r in evicted],
+                       step_ms=round((self.clock() - t0) * 1e3, 3))
+            progress = True
+        elif evicted:
+            progress = True
+        self.steps += 1
+        if isinstance(self.clock, SimClock):
+            self.clock.advance()
+        return progress
+
+    # -- drivers -----------------------------------------------------------
+
+    def run(self, max_steps: int = 100_000) -> List[Request]:
+        """Step until every queued request has finished; returns the
+        finished list (scheduler order)."""
+        for _ in range(max_steps):
+            if self.sched.idle:
+                break
+            self.step()
+        else:
+            raise RuntimeError(f"engine did not drain in {max_steps} steps")
+        self._retire(self.clock())
+        return self.sched.finished
+
+    def serve(self, trace: Sequence[Request], *,
+              max_steps: int = 1_000_000) -> List[Request]:
+        """Run an arrival trace (requests sorted by ``arrival_t``):
+        each request is submitted once the clock passes its arrival
+        time; with a real clock the engine sleeps through idle gaps,
+        with a :class:`SimClock` it advances virtual time.  Trace
+        arrival times are RELATIVE to the start of the call — they are
+        rebased in place onto the engine clock, so TTFT (first token
+        minus arrival) is measured on one time base.  Requests are
+        therefore SINGLE-USE: re-serving a trace object would
+        double-rebase its arrivals (and replay half-mutated request
+        state), so a non-fresh request is rejected up front —
+        regenerate the trace instead."""
+        pending = sorted(trace, key=lambda r: (r.arrival_t, r.rid))
+        for req in pending:
+            if req.state != WAITING or req.generated or req.pages \
+                    or req.kv_len:
+                raise ValueError(
+                    f"request {req.rid} is not fresh "
+                    f"(state={req.state!r}) — trace requests are "
+                    "single-use; regenerate the trace")
+        t_base = self.clock()
+        for req in pending:
+            req.arrival_t += t_base
+        i = 0
+        for _ in range(max_steps):
+            now = self.clock()
+            while i < len(pending) and pending[i].arrival_t <= now:
+                self.submit_request(pending[i])
+                i += 1
+            if not self.sched.idle:
+                self.step()
+            elif i < len(pending):
+                gap = pending[i].arrival_t - now
+                if isinstance(self.clock, SimClock):
+                    self.clock.advance()
+                elif gap > 0:
+                    time.sleep(min(gap, 0.05))
+            else:
+                break
+        else:
+            raise RuntimeError(f"trace did not drain in {max_steps} steps")
+        self._retire(self.clock())
+        return self.sched.finished

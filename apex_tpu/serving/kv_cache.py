@@ -1,0 +1,190 @@
+"""Paged KV cache: a preallocated HBM page pool + host-side page
+accounting.
+
+The training stack's KV tensors are per-call slabs; a serving engine
+instead holds MANY requests' caches alive at once, each growing by one
+token per decode step and dying at unpredictable times.  A slab per
+request would fragment HBM and force reallocation-and-copy on growth —
+the standard answer (vLLM's PagedAttention, SURVEY-adjacent) is a pool
+of fixed-size pages:
+
+* ``k``/``v``: ``[num_layers, num_pages, page_size, num_heads,
+  head_dim]`` device arrays, allocated ONCE at engine start.  A
+  request's cache is a *page list* — pages need not be contiguous, so
+  the pool never fragments and "grow by one token" is at most "append
+  one page id to a python list".
+* Page 0 is the reserved **scratch page**: it is never allocated, page
+  tables pad their rows with it, and packed-prefill scatter routes its
+  padding positions there.  Readers never see its content (the decode
+  kernel and the XLA baseline both mask columns past ``kv_len``), so
+  duplicate pad writes landing in it are harmless by construction.
+* Host-side accounting (free list, per-page owner) is plain python —
+  allocation is LOWEST-INDEX-FIRST so every run of the scheduler is
+  bit-reproducible.
+
+The device arrays are functionally updated (``.at[].set``); the cache
+object re-binds them, so callers treat ``cache.k``/``cache.v`` as the
+current pool state (and may thread them through ``jax.jit`` as loop
+carries).
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _scatter_tokens(k_pool, v_pool, k_new, v_new, pages, offsets):
+    return (k_pool.at[:, pages, offsets].set(k_new),
+            v_pool.at[:, pages, offsets].set(v_new))
+
+
+class PagePoolExhausted(RuntimeError):
+    """No free pages left — the scheduler's cue to preempt, never an
+    OOM: the pool size is fixed at construction and allocation failure
+    is an ordinary, recoverable scheduling event."""
+
+
+class PagedKVCache:
+    """Fixed-size paged KV pool shared by all in-flight requests.
+
+    ``max_pages_per_request`` fixes the page-table width ``p_max`` —
+    every decode step sees a static ``[batch, p_max]`` table, so
+    admitting or retiring requests never recompiles the step.
+    """
+
+    def __init__(self, *, num_layers: int, num_pages: int,
+                 page_size: int, num_heads: int, head_dim: int,
+                 max_pages_per_request: int,
+                 dtype=jnp.float32):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is the "
+                             "reserved scratch page)")
+        if max_pages_per_request > num_pages - 1:
+            raise ValueError(
+                f"max_pages_per_request {max_pages_per_request} exceeds "
+                f"the {num_pages - 1} allocatable pages")
+        self.num_layers = num_layers
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.num_heads = num_heads
+        self.head_dim = head_dim
+        self.max_pages_per_request = max_pages_per_request
+        shape = (num_layers, num_pages, page_size, num_heads, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        # the prefill scatter donates the old pool on TPU so the
+        # update is in-place — two full-pool copies per admission
+        # would otherwise sit on the TTFT-critical path
+        donate = (0, 1) if jax.default_backend() == "tpu" else ()
+        self._scatter = jax.jit(_scatter_tokens, donate_argnums=donate)
+        # sorted free list, lowest-first allocation: deterministic
+        self._free: List[int] = list(range(1, num_pages))
+        self._owner: Dict[int, int] = {}
+
+    # -- accounting ------------------------------------------------------
+
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_used(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_size)  # ceil
+
+    def allocate(self, n: int, owner: int) -> List[int]:
+        """Take ``n`` free pages for ``owner`` (a request id); raises
+        :class:`PagePoolExhausted` — with the pool untouched — when
+        fewer than ``n`` are free."""
+        if n > len(self._free):
+            raise PagePoolExhausted(
+                f"need {n} pages, {len(self._free)} free "
+                f"({self.pages_used}/{self.num_pages - 1} in use)")
+        pages, self._free = self._free[:n], self._free[n:]
+        for p in pages:
+            self._owner[p] = owner
+        return pages
+
+    def free(self, pages: Sequence[int]) -> None:
+        """Return pages to the pool (retirement or preemption).  The
+        page CONTENT is left in place — readers mask by ``kv_len``, so
+        stale values are unreachable, and skipping the zero-fill keeps
+        retirement free."""
+        for p in pages:
+            if p == 0 or p in self._free:
+                raise ValueError(f"double free / scratch free: page {p}")
+            self._owner.pop(p, None)
+            bisect.insort(self._free, p)
+
+    def owner_of(self, page: int) -> Optional[int]:
+        return self._owner.get(page)
+
+    # -- device-facing views ---------------------------------------------
+
+    def page_table(self, page_lists: Sequence[Sequence[int]],
+                   rows: Optional[int] = None) -> jnp.ndarray:
+        """``[rows, max_pages_per_request]`` int32 table, each row a
+        request's page list in cache order, padded with the scratch
+        page 0 (padding the row with a REPEATED valid index also lets
+        the decode kernel's block pipeline elide the dead DMAs)."""
+        rows = len(page_lists) if rows is None else rows
+        t = np.zeros((rows, self.max_pages_per_request), np.int32)
+        for i, pages in enumerate(page_lists):
+            if len(pages) > self.max_pages_per_request:
+                raise ValueError(
+                    f"page list of {len(pages)} exceeds "
+                    f"max_pages_per_request={self.max_pages_per_request}")
+            t[i, :len(pages)] = pages
+        return jnp.asarray(t)
+
+    def write_tokens(self, k_new: jnp.ndarray, v_new: jnp.ndarray,
+                     pages: jnp.ndarray, offsets: jnp.ndarray) -> None:
+        """Scatter per-token K/V into the pool (the prefill fill path).
+
+        ``k_new``/``v_new``: ``[num_layers, T, num_heads, head_dim]``;
+        token t lands in ``(pages[t], offsets[t])``.  Padding positions
+        point at the scratch page 0."""
+        pages = jnp.asarray(pages, jnp.int32)
+        offsets = jnp.asarray(offsets, jnp.int32)
+        self.k, self.v = self._scatter(
+            self.k, self.v, k_new, v_new, pages, offsets)
+
+    # -- defrag ----------------------------------------------------------
+
+    def defrag(self, page_lists: Sequence[List[int]]) -> Dict[int, int]:
+        """Compact live pages to the lowest pool indices.
+
+        A long-running pool ends up with live pages scattered across
+        the index space; compaction restores the dense prefix layout a
+        fresh pool has (locality for the pool DMAs, and a cheap
+        "occupancy == high-water-mark" invariant).  ``page_lists`` are
+        the page lists of every live request, IN PLACE — they are
+        rewritten to the new ids.  Returns the old→new mapping.
+        Content moves by one device gather per pool array."""
+        live: List[int] = []
+        for pages in page_lists:
+            live.extend(pages)
+        if len(set(live)) != len(live):
+            raise ValueError("page lists overlap — pool corruption")
+        mapping = {old: new for new, old in enumerate(live, start=1)}
+        src = np.arange(self.num_pages)
+        for old, new in mapping.items():
+            src[new] = old
+        # pages outside the live prefix keep whatever content the
+        # gather assigns them — they are free, nothing reads them
+        src_j = jnp.asarray(src, jnp.int32)
+        self.k = self.k[:, src_j]
+        self.v = self.v[:, src_j]
+        self._owner = {mapping[p]: o for p, o in self._owner.items()
+                       if p in mapping}
+        self._free = list(range(len(live) + 1, self.num_pages))
+        for pages in page_lists:
+            pages[:] = [mapping[p] for p in pages]
+        return mapping

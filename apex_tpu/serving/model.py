@@ -1,0 +1,201 @@
+"""GPT-style decoder with a paged KV cache — the serving engine's
+model half.
+
+Two entry points mirror the two phases of continuous batching:
+
+* :meth:`PagedDecoder.prefill` — the ADMISSION path.  A fixed-width
+  packed token row with segment ids (the PR 5 varlen packed path:
+  cross-segment tiles are masked in-kernel and skipped by the
+  block-skip index on TPU) — one fixed-shape forward per call, no
+  recompiles.  The row format carries ANY number of segments, but the
+  engine feeds ONE request per row: a multi-segment row is not
+  offset-invariant at the last ulp (the attention contraction's
+  reduction grouping depends on where a segment starts), which would
+  break the engine's bitwise batched-vs-sequential contract — see
+  ``engine.py`` "The isolation contract".  It returns per-layer K/V
+  for every packed position; the engine scatters them into the page
+  pool.
+* :meth:`PagedDecoder.decode` — the STEADY-STATE path.  One token per
+  running request: append the token's K/V into its current page, then
+  attend over the request's page list via
+  :func:`~apex_tpu.ops.flash_decode` (the r8 decode route).  Batch
+  width is fixed at the engine's ``max_batch`` with idle rows masked,
+  so this too is one compiled step for the whole serving lifetime.
+
+Per-row independence is a hard contract: every op in ``decode`` is
+row-wise (embedding lookup, layer norm, per-row matmuls, paged
+attention over the row's own page list), which is what makes batched
+continuous decoding produce bit-identical tokens to one-request-at-a-
+time decoding — the scheduler composes batches freely without
+perturbing anyone's output.
+
+The parameter layout is a plain pytree (:func:`init_params`) with tied
+embeddings; fp32 by default (the serving tests pin bitwise claims),
+bf16 for TPU throughput via ``ServingModelConfig(dtype=...)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import flash_attention, flash_decode
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingModelConfig:
+    """Decoder geometry.  ``max_position`` bounds the learned position
+    table — admission must reject requests that could outgrow it."""
+
+    vocab_size: int = 256
+    hidden_size: int = 64
+    num_heads: int = 4
+    num_layers: int = 2
+    max_position: int = 512
+    mlp_ratio: int = 4
+    dtype: object = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        if self.hidden_size % self.num_heads:
+            raise ValueError("hidden_size must divide by num_heads")
+        return self.hidden_size // self.num_heads
+
+
+def init_params(cfg: ServingModelConfig, seed: int = 0):
+    """Deterministic parameter pytree (scaled-normal init, tied LM
+    head = embedding transpose)."""
+    keys = jax.random.split(jax.random.PRNGKey(seed),
+                            2 + 4 * cfg.num_layers)
+    h, r = cfg.hidden_size, cfg.mlp_ratio
+    dt = cfg.dtype
+
+    def norm(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                / math.sqrt(fan_in)).astype(dt)
+
+    params = {
+        "embed": norm(keys[0], (cfg.vocab_size, h), h),
+        "pos": norm(keys[1], (cfg.max_position, h), h),
+        "ln_f": {"g": jnp.ones((h,), dt), "b": jnp.zeros((h,), dt)},
+        "layers": [],
+    }
+    for i in range(cfg.num_layers):
+        k = keys[2 + 4 * i: 6 + 4 * i]
+        params["layers"].append({
+            "ln1": {"g": jnp.ones((h,), dt), "b": jnp.zeros((h,), dt)},
+            "wqkv": norm(k[0], (h, 3 * h), h),
+            "wo": norm(k[1], (h, h), h),
+            "ln2": {"g": jnp.ones((h,), dt), "b": jnp.zeros((h,), dt)},
+            "w1": norm(k[2], (h, r * h), h),
+            "w2": norm(k[3], (r * h, h), r * h),
+        })
+    return params
+
+
+def _ln(x, p):
+    m = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - m), axis=-1, keepdims=True)
+    return (x - m) * jax.lax.rsqrt(var + 1e-5) * p["g"] + p["b"]
+
+
+def _mlp(x, layer):
+    return jax.nn.gelu(x @ layer["w1"]) @ layer["w2"]
+
+
+class PagedDecoder:
+    """The decoder model over the cache layouts the engine owns (the
+    engine holds params/pool; this class is pure functions of them)."""
+
+    def __init__(self, cfg: ServingModelConfig):
+        self.cfg = cfg
+
+    # -- admission: packed varlen prefill --------------------------------
+
+    def prefill(self, params, tokens: jnp.ndarray, seg: jnp.ndarray,
+                positions: jnp.ndarray,
+                last_index: Optional[jnp.ndarray] = None,
+                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """tokens/seg/positions ``[1, S]`` (one packed row; seg 0 =
+        padding, real segments 1..n; positions restart per segment).
+        Returns (logits, k, v ``[L, 1, S, H, D]``) — K/V for every
+        packed position, for the engine to scatter into pages.
+
+        ``last_index`` (traced int scalar, so the compiled shape never
+        changes): compute logits ``[1, 1, vocab]`` for that single
+        position only.  Admission needs exactly one next-token
+        distribution (the last context position) — projecting all S
+        rows through the LM head would put an S×hidden×vocab matmul on
+        the TTFT-critical path for one useful row.  ``None`` returns
+        the full ``[1, S, vocab]`` logits (teacher-forcing/scoring
+        use)."""
+        cfg = self.cfg
+        hd, nh = cfg.head_dim, cfg.num_heads
+        x = params["embed"][tokens] + params["pos"][positions]
+        ks, vs = [], []
+        for layer in params["layers"]:
+            hdn = _ln(x, layer["ln1"])
+            qkv = hdn @ layer["wqkv"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            b, s = q.shape[:2]
+            q4 = q.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+            k4 = k.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+            v4 = v.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+            ctx = flash_attention(q4, k4, v4, causal=True,
+                                  segment_ids=seg)
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, -1)
+            x = x + ctx @ layer["wo"]
+            x = x + _mlp(_ln(x, layer["ln2"]), layer)
+            ks.append(k.reshape(b, s, nh, hd))
+            vs.append(v.reshape(b, s, nh, hd))
+        x = _ln(x, params["ln_f"])
+        if last_index is not None:
+            x = jax.lax.dynamic_slice_in_dim(
+                x, jnp.asarray(last_index, jnp.int32), 1, axis=1)
+        logits = x @ params["embed"].T
+        return logits, jnp.stack(ks), jnp.stack(vs)
+
+    # -- steady state: paged decode --------------------------------------
+
+    def decode(self, params, k_pool, v_pool, tokens: jnp.ndarray,
+               positions: jnp.ndarray, page_table: jnp.ndarray,
+               kv_len: jnp.ndarray):
+        """One decode step for a fixed-width batch.
+
+        ``tokens``/``positions`` ``[b]``: each row's newest token and
+        its 0-based sequence position; ``kv_len = positions + 1`` (the
+        flash_decode contract: the count includes the query token,
+        whose K/V this step appends).  ``page_table`` ``[b, p_max]``.
+        Idle rows carry position 0 / kv_len 1 / an all-scratch page
+        row; their writes land in scratch page 0 and their outputs are
+        discarded by the engine.  Returns (logits ``[b, vocab]``,
+        k_pool', v_pool')."""
+        cfg = self.cfg
+        hd, nh = cfg.head_dim, cfg.num_heads
+        page_size = k_pool.shape[2]
+        x = params["embed"][tokens] + params["pos"][positions]  # [b, h]
+        page_slot = positions // page_size
+        page_idx = jnp.take_along_axis(
+            page_table, page_slot[:, None], axis=1)[:, 0]
+        offset = positions % page_size
+        for li, layer in enumerate(params["layers"]):
+            hdn = _ln(x, layer["ln1"])
+            qkv = hdn @ layer["wqkv"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            b = q.shape[0]
+            k_pool = k_pool.at[li, page_idx, offset].set(
+                k.reshape(b, nh, hd))
+            v_pool = v_pool.at[li, page_idx, offset].set(
+                v.reshape(b, nh, hd))
+            q4 = q.reshape(b, 1, nh, hd).transpose(0, 2, 1, 3)
+            ctx = flash_decode(q4, k_pool[li], v_pool[li],
+                               page_table, kv_len)
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(b, -1)
+            x = x + ctx @ layer["wo"]
+            x = x + _mlp(_ln(x, layer["ln2"]), layer)
+        logits = _ln(x, params["ln_f"]) @ params["embed"].T
+        return logits, k_pool, v_pool

@@ -1,0 +1,236 @@
+"""Continuous-batching scheduler: host-side admission / growth /
+preemption / retirement policy.
+
+Static-batching serving (pad every request to the longest, decode until
+ALL finish) wastes most of the chip on retired-or-absent rows; the
+continuous-batching answer (Orca/vLLM lineage) re-forms the batch
+BETWEEN decode steps: finished requests leave immediately, waiting
+requests join whenever a batch slot, prefill-token budget, and KV pages
+are available.  This module is the pure-python policy half — it owns
+request lifecycles and the page accounting, and never touches device
+state (the :class:`~apex_tpu.serving.engine.ServingEngine` turns its
+decisions into prefill/decode calls).
+
+Policy (all deterministic — FIFO queues, lowest-first page allocation —
+so a seeded arrival trace replays bit-identically):
+
+* **admission**: FIFO over the waiting queue while (a) a batch slot is
+  open, (b) this step's prefill-token budget has room for the
+  request's context, and (c) the pool can supply its context pages.
+  ``prefill_budget`` plays two roles: per REQUEST it is the fixed
+  prefill row width (``submit`` rejects contexts that could outgrow
+  it), and per STEP it caps the total prefill tokens admitted between
+  two decode steps — each admission is its own fixed-width launch (the
+  engine's isolation contract), so the step cap is not a packing
+  constraint but head-of-line-latency control: admitting unbounded
+  prefill work in one step would stall every running request's next
+  token.  First failure stops admission for this step (no out-of-order
+  admission — fairness over packing efficiency).
+* **growth**: before each decode step every running request crossing a
+  page boundary gets one page.
+* **preemption**: when growth (or nothing-running admission) finds the
+  pool empty, the MOST-RECENTLY-admitted running request is evicted —
+  its pages are freed, its generated-so-far TOKENS are kept, and it
+  rejoins the FRONT of the waiting queue; on re-admission its context
+  (prompt + generated) is re-prefilled, deterministically regenerating
+  its KV from the kept tokens, so preemption is invisible in the
+  output stream (pinned token-for-token by
+  ``test_preemption_is_output_invisible``; the regenerated KV is the
+  same computation, not byte-for-byte the same buffers —
+  docs/serving.md "Preemption").
+* **retirement**: EOS or ``max_new_tokens`` reached → pages freed (and
+  immediately reusable), terminal state recorded.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Deque, List, Optional
+
+from apex_tpu.serving.kv_cache import PagedKVCache, PagePoolExhausted
+
+WAITING = "waiting"
+RUNNING = "running"
+FINISHED = "finished"
+
+
+@dataclasses.dataclass
+class Request:
+    """One serving request and its runtime state."""
+
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+    arrival_t: float = 0.0
+    # runtime
+    state: str = WAITING
+    generated: List[int] = dataclasses.field(default_factory=list)
+    pages: List[int] = dataclasses.field(default_factory=list)
+    kv_len: int = 0               # tokens whose K/V sit in the pool
+    preemptions: int = 0
+    admit_t: Optional[float] = None
+    first_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+    finish_reason: Optional[str] = None
+
+    @property
+    def context(self) -> List[int]:
+        """Tokens whose K/V must be cached at (re-)admission: the
+        prompt plus everything generated before a preemption."""
+        return self.prompt + self.generated
+
+    @property
+    def seq_len(self) -> int:
+        return len(self.prompt) + len(self.generated)
+
+    @property
+    def done(self) -> bool:
+        if self.eos_id is not None and self.generated \
+                and self.generated[-1] == self.eos_id:
+            return True
+        return len(self.generated) >= self.max_new_tokens
+
+
+class ContinuousBatchingScheduler:
+    """Admission/growth/preemption/retirement over a shared page pool."""
+
+    def __init__(self, cache: PagedKVCache, *, max_batch: int,
+                 prefill_budget: int, max_position: int):
+        self.cache = cache
+        self.max_batch = max_batch
+        self.prefill_budget = prefill_budget
+        self.max_position = max_position
+        self.waiting: Deque[Request] = deque()
+        self.running: List[Request] = []   # admission order
+        self.finished: List[Request] = []
+
+    # -- intake ----------------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        """Queue a request; rejects up front what could NEVER be
+        served (so capacity failures later are always transient)."""
+        worst = len(req.prompt) + req.max_new_tokens
+        if worst > self.max_position:
+            raise ValueError(
+                f"request {req.rid}: prompt+max_new {worst} exceeds "
+                f"max_position {self.max_position}")
+        if self.cache.pages_needed(worst) > \
+                self.cache.max_pages_per_request:
+            raise ValueError(
+                f"request {req.rid}: needs up to "
+                f"{self.cache.pages_needed(worst)} pages > "
+                f"max_pages_per_request "
+                f"{self.cache.max_pages_per_request}")
+        if worst > self.prefill_budget:
+            # the PREEMPTION contract needs the whole worst-case
+            # context (prompt + everything it may generate) to fit the
+            # fixed prefill row width, or an evicted request could
+            # never be re-admitted
+            raise ValueError(
+                f"request {req.rid}: prompt+max_new {worst} exceeds "
+                f"prefill budget {self.prefill_budget}")
+        self.waiting.append(req)
+
+    # -- admission -------------------------------------------------------
+
+    def admit(self) -> List[Request]:
+        """Admit FIFO-eligible requests for this step (each gets its
+        own prefill launch; the shared ``prefill_budget`` decrement
+        caps this STEP's total prefill work — see the module
+        docstring).  Returns the admitted list (pages allocated, state
+        RUNNING); never raises on capacity — a full pool just admits
+        fewer."""
+        admitted: List[Request] = []
+        budget = self.prefill_budget
+        while self.waiting and \
+                len(self.running) + len(admitted) < self.max_batch:
+            req = self.waiting[0]
+            ctx = len(req.context)
+            if ctx > budget:
+                break
+            try:
+                pages = self.cache.allocate(
+                    self.cache.pages_needed(ctx), req.rid)
+            except PagePoolExhausted:
+                if not self.running and not admitted:
+                    # nothing to preempt and nothing in flight: the
+                    # waiting request's context alone exceeds the pool
+                    # minus other waiters' leavings — surface it, this
+                    # is a sizing bug, not a transient
+                    raise
+                break
+            self.waiting.popleft()
+            req.pages = pages
+            req.state = RUNNING
+            budget -= ctx
+            admitted.append(req)
+        self.running.extend(admitted)
+        return admitted
+
+    # -- growth / preemption ---------------------------------------------
+
+    def preempt_one(self) -> Optional[Request]:
+        """Evict the most-recently-admitted running request: free its
+        pages, keep its tokens, requeue it at the FRONT of the waiting
+        queue.  Returns the victim (or None if nothing runs)."""
+        if not self.running:
+            return None
+        victim = self.running.pop()
+        self.cache.free(victim.pages)
+        victim.pages = []
+        victim.kv_len = 0
+        victim.state = WAITING
+        victim.preemptions += 1
+        self.waiting.appendleft(victim)
+        return victim
+
+    def ensure_decode_capacity(self) -> List[Request]:
+        """Give every running request the page its next token needs,
+        preempting from the back of the batch when the pool runs dry.
+        Returns the requests preempted (possibly including ones that
+        had already grown — eviction strictly follows admission
+        order)."""
+        evicted: List[Request] = []
+        for req in list(self.running):
+            if req not in self.running:
+                continue  # evicted while growing an earlier request
+            while req in self.running:
+                need_pages = self.cache.pages_needed(req.seq_len)
+                if len(req.pages) >= need_pages:
+                    break
+                try:
+                    req.pages.extend(
+                        self.cache.allocate(
+                            need_pages - len(req.pages), req.rid))
+                except PagePoolExhausted:
+                    # the victim can be ``req`` itself (it is the
+                    # newest admission left): then the loop's membership
+                    # check ends its growth and it waits its turn
+                    victim = self.preempt_one()
+                    assert victim is not None  # self.running non-empty
+                    evicted.append(victim)
+        return evicted
+
+    # -- retirement ------------------------------------------------------
+
+    def retire_finished(self, now: float) -> List[Request]:
+        """Move done requests out of the batch and free their pages —
+        the pages are reusable by the very next admission."""
+        done = [r for r in self.running if r.done]
+        for req in done:
+            self.running.remove(req)
+            self.cache.free(req.pages)
+            req.pages = []
+            req.state = FINISHED
+            req.finish_t = now
+            req.finish_reason = (
+                "eos" if req.eos_id is not None and req.generated
+                and req.generated[-1] == req.eos_id else "length")
+            self.finished.append(req)
+        return done
+
+    @property
+    def idle(self) -> bool:
+        return not self.waiting and not self.running

@@ -54,6 +54,12 @@ EVENT_TYPES = frozenset({
     "data_stall",      # input pipeline made the step wait (dry prefetch
                        # queue, slow shard read, shard re-assignment)
     "data_quarantine",  # a damaged record was skipped and counted
+    "request_admit",   # serving: request admitted (or re-admitted after
+                       # preemption) and prefilled into the page pool
+    "request_retire",  # serving: request finished (eos/length) with its
+                       # per-request TTFT/TPOT latency record
+    "decode_step",     # serving: one continuous-batching decode step
+                       # (batch width, tokens, page-pool occupancy)
 })
 
 

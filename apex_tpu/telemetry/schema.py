@@ -54,6 +54,16 @@ PAYLOAD_REQUIRED: Dict[str, Dict[str, tuple]] = {
     "data_stall": {"wait_ms": NUMBER, "cause": (str,)},
     "data_quarantine": {"record_id": (int,), "reason": (str,),
                         "total": (int,)},
+    # serving events (ISSUE 8): latency fields (ttft_ms/tpot_ms on
+    # retire, step_ms/evicted on decode_step) are optional — a
+    # one-token request has no TPOT, and optionality must be explicit
+    # in the schema, not smuggled via sentinel values
+    "request_admit": {"rid": (int,), "context_tokens": (int,),
+                      "pages": (int,), "preemptions": (int,)},
+    "request_retire": {"rid": (int,), "reason": (str,),
+                       "new_tokens": (int,), "preemptions": (int,)},
+    "decode_step": {"batch": (int,), "new_tokens": (int,),
+                    "pool_used": (int,), "pool_pages": (int,)},
 }
 
 

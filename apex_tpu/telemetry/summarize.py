@@ -35,6 +35,9 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     skipped_steps = 0
     run_end: Optional[Dict[str, Any]] = None
     t_lo = t_hi = None
+    tpot_ms: List[float] = []
+    ttft_ms: List[float] = []
+    pool_occ: List[float] = []
     for ev in events:
         counts[ev.get("type", "?")] = counts.get(ev.get("type", "?"), 0) + 1
         t = ev.get("t")
@@ -47,6 +50,15 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                 skipped_steps += 1
         elif ev.get("type") == "run_end":
             run_end = ev
+        elif ev.get("type") == "request_retire":
+            if ev.get("tpot_ms") is not None:
+                tpot_ms.append(float(ev["tpot_ms"]))
+            if ev.get("ttft_ms") is not None:
+                ttft_ms.append(float(ev["ttft_ms"]))
+        elif ev.get("type") == "decode_step":
+            if ev.get("pool_pages"):
+                pool_occ.append(ev.get("pool_used", 0)
+                                / ev["pool_pages"])
 
     s = sorted(step_ms)
     run_ids = list(dict.fromkeys(
@@ -70,6 +82,20 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "data_stalls": counts.get("data_stall", 0),
         "records_quarantined": counts.get("data_quarantine", 0),
     }
+    if counts.get("request_retire") or counts.get("decode_step"):
+        # serving summary (ISSUE 8): the one-screen view of a serving
+        # stream is latency percentiles + pool pressure, not step time
+        st, sf = sorted(tpot_ms), sorted(ttft_ms)
+        out["serving_requests"] = counts.get("request_retire", 0)
+        out["serving_decode_steps"] = counts.get("decode_step", 0)
+        out["serving_tpot_p50"] = (round(percentile(st, 0.50), 3)
+                                   if st else None)
+        out["serving_tpot_p95"] = (round(percentile(st, 0.95), 3)
+                                   if st else None)
+        out["serving_ttft_p50"] = (round(percentile(sf, 0.50), 3)
+                                   if sf else None)
+        out["serving_pool_peak"] = (round(max(pool_occ), 4)
+                                    if pool_occ else None)
     if len(run_ids) > 1:
         # JsonlSink appends: a restarted job continues its stream file
         # under a new run_id.  Aggregating across runs is legitimate,
@@ -121,6 +147,16 @@ def format_summary(s: Dict[str, Any]) -> str:
     if buckets:
         lines.append("time split  " + "  ".join(
             f"{k} {v:.2f}s" for k, v in sorted(buckets.items())))
+    if s.get("serving_requests") is not None:
+        parts = [f"serving     requests {s['serving_requests']}"]
+        if s.get("serving_tpot_p50") is not None:
+            parts.append(f"tpot p50 {_ms(s['serving_tpot_p50'])} "
+                         f"p95 {_ms(s.get('serving_tpot_p95'))}")
+        if s.get("serving_ttft_p50") is not None:
+            parts.append(f"ttft p50 {_ms(s['serving_ttft_p50'])}")
+        if s.get("serving_pool_peak") is not None:
+            parts.append(f"pool peak {_pct(s['serving_pool_peak'])}")
+        lines.append("  ".join(parts))
     if s.get("data_stalls") or s.get("records_quarantined"):
         parts = [f"data        stalls {s.get('data_stalls', 0)}"]
         if s.get("records_quarantined"):
@@ -149,6 +185,7 @@ _DIFF_ROWS = (
     ("goodput", "goodput", "{:.3f}"),
     ("steps_per_sec", "steps/s", "{:.3f}"),
     ("data_stalls", "data stalls", "{:d}"),
+    ("serving_tpot_p50", "tpot p50 (ms)", "{:.2f}"),
 )
 
 

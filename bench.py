@@ -1157,6 +1157,109 @@ def bench_bert_large(roof):
     return out
 
 
+def bench_serving():
+    """Inference serving flagship (ISSUE 8): the continuous-batching
+    engine under a seeded Poisson arrival trace.
+
+    Geometry is the GPT-flagship per-layer config (h=2048, 16 heads →
+    d=128, vocab 51200; ``BENCH_SERVING_LAYERS`` defaults to the full
+    24) in bf16 over a paged KV pool.  A seeded trace
+    (:func:`~apex_tpu.serving.poisson_trace`) arrives at
+    ``BENCH_SERVING_RATE`` req/s; the engine admits via fixed-shape
+    prefill, decodes via :func:`~apex_tpu.ops.flash_decode`, and emits
+    the serving telemetry stream (telemetry/serving.jsonl), which this
+    bench schema-validates with the PR 4 validator before reading its
+    latency percentiles back out.  Headline keys:
+    ``decode_tokens_per_sec`` (decode-phase tokens over decode-phase
+    wall — the steady-state throughput number),
+    ``serving_tpot_p50/p95`` (time-per-output-token),
+    ``serving_ttft_p50`` (admission-to-first-token, queueing included)
+    and ``serving_pool_peak`` (page-pool occupancy high-water mark).
+    """
+    from apex_tpu import telemetry as tel
+    from apex_tpu.serving import (ServingEngine, ServingModelConfig,
+                                  init_params, poisson_trace)
+
+    L = int(os.environ.get("BENCH_SERVING_LAYERS", "24"))
+    H = int(os.environ.get("BENCH_SERVING_HIDDEN", "2048"))
+    NH = int(os.environ.get("BENCH_SERVING_HEADS", "16"))
+    V = int(os.environ.get("BENCH_SERVING_VOCAB", "51200"))
+    n_req = int(os.environ.get("BENCH_SERVING_REQS", "24"))
+    rate = float(os.environ.get("BENCH_SERVING_RATE", "8"))
+    max_batch = int(os.environ.get("BENCH_SERVING_BATCH", "8"))
+    page_size = int(os.environ.get("BENCH_SERVING_PAGE", "64"))
+    max_pos = int(os.environ.get("BENCH_SERVING_MAXPOS", "1024"))
+    cfg = ServingModelConfig(
+        vocab_size=V, hidden_size=H, num_heads=NH, num_layers=L,
+        max_position=max_pos, dtype=jnp.bfloat16)
+    params = init_params(cfg, seed=0)
+
+    # trace shape scales with the position budget (at the default
+    # max_pos=1024: prompts 64..256, generation budgets 16..64)
+    prompt_len = (max(4, max_pos // 16), max(8, max_pos // 4))
+    max_new = (max(2, max_pos // 64), max(4, max_pos // 16))
+    pages_per_req = -(-(prompt_len[1] + max_new[1]) // page_size)
+    # 1.5x the worst simultaneous footprint: headroom for steady state,
+    # small enough that a bursty trace still exercises pool pressure
+    num_pages = 1 + max_batch * pages_per_req * 3 // 2
+
+    tel_dir = os.environ.get("BENCH_TELEMETRY_DIR") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "telemetry")
+    stream = os.path.join(tel_dir, "serving.jsonl")
+    try:
+        os.remove(stream)
+    except OSError:
+        pass
+    mem = tel.MemorySink()
+    bus = tel.TelemetryBus(run_id=f"serving-{os.getpid()}",
+                           sinks=[tel.JsonlSink(stream), mem])
+    eng = ServingEngine(cfg, params, num_pages=num_pages,
+                        page_size=page_size, max_batch=max_batch,
+                        max_pages_per_request=pages_per_req,
+                        prefill_budget=max_pos, telemetry=bus)
+
+    # warm both compiled shapes OUTSIDE the measured trace (and outside
+    # the stream: TTFT must not carry jit compile time)
+    compile_s = eng.warmup()
+
+    trace = poisson_trace(0, n_req, rate=rate, prompt_len=prompt_len,
+                          max_new=max_new, vocab_size=V)
+    t0 = time.perf_counter()
+    finished = eng.serve(trace)
+    wall_s = time.perf_counter() - t0
+    bus.close()
+
+    n_events = tel.validate_jsonl(stream)  # the acceptance contract
+    s = tel.summarize_events(mem.events)
+    decode_tokens = sum(ev.get("new_tokens", 0) for ev in mem.events
+                        if ev.get("type") == "decode_step")
+    decode_s = sum(ev.get("step_ms", 0.0) for ev in mem.events
+                   if ev.get("type") == "decode_step") / 1e3
+    total_tokens = sum(len(r.generated) for r in finished)
+    return {
+        "serving_requests": len(finished),
+        "serving_tokens_total": total_tokens,
+        "decode_tokens_per_sec": round(decode_tokens / decode_s, 1)
+        if decode_s > 0 else None,
+        "serving_tpot_p50": s.get("serving_tpot_p50"),
+        "serving_tpot_p95": s.get("serving_tpot_p95"),
+        "serving_ttft_p50": s.get("serving_ttft_p50"),
+        "serving_pool_peak": s.get("serving_pool_peak"),
+        "serving_decode_steps": eng.decode_steps,
+        "serving_preemptions": sum(r.preemptions for r in finished),
+        "serving_wall_s": round(wall_s, 2),
+        "serving_compile_s": round(compile_s, 2),
+        "serving_stream_events": n_events,
+        "serving_telemetry_file": os.path.basename(stream),
+        "serving_config": {
+            "layers": L, "hidden": H, "heads": NH, "vocab": V,
+            "dtype": "bf16", "page_size": page_size,
+            "num_pages": num_pages, "max_batch": max_batch,
+            "rate_req_s": rate, "n_requests": n_req,
+        },
+    }
+
+
 def bench_attention_varlen():
     """Varlen attention micro-sweep over the reference FMHA seqlens
     {128, 256, 384, 512} at head dim 64 (fmha.py:36-41), ISSUE 5.
@@ -2069,6 +2172,12 @@ def main():
         bert = attempt("bert_large", lambda: bench_bert_large(roof))
         if bert is not None:
             extras.update(bert)
+
+        # the r8 flagship (ISSUE 8): continuous-batching inference
+        # serving under a seeded Poisson arrival trace
+        srv = attempt("serving", bench_serving)
+        if srv is not None:
+            extras.update(srv)
 
     sidecar = {}
     if not FAST:
