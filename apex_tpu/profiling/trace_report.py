@@ -34,7 +34,8 @@ import numpy as np
 
 __all__ = ["OpTime", "parse_trace_dir", "top_ops_report",
            "format_top_ops", "device_time_ms", "hlo_fusion_flops",
-           "join_roofline"]
+           "join_roofline", "PHASES", "classify_op", "PhaseReport",
+           "phase_report", "flash_attention_flops"]
 
 
 @dataclasses.dataclass
@@ -97,20 +98,19 @@ def _leaf_events(events):
     return leaves
 
 
-def parse_trace_dir(logdir: str, *, device_only: bool = True
-                    ) -> List[OpTime]:
-    """Aggregate complete ('X') events from every ``*.trace.json.gz``
-    under ``logdir`` into per-name totals, device timeline only (pids
-    whose process name mentions a device) unless ``device_only=False``
-    or no device pids exist (then: every non-metadata timeline).  Only
-    *leaf* events count — containers (step lanes, module spans) hold
-    their children's time and would double-count."""
+def _trace_leaf_groups(logdir: str, *, device_only: bool = True):
+    """Yield one list of LEAF complete-events per trace file under
+    ``logdir`` (timestamps are only mutually comparable within a file,
+    so overlap analysis must stay per-group).  A generator on purpose:
+    a multi-host capture can hold many ~1M-event files, and only one
+    file's events should be resident at a time.  Device timeline only
+    (pids whose process name mentions a device) unless
+    ``device_only=False`` or no device pids exist (then: every
+    non-metadata timeline)."""
     paths = glob.glob(os.path.join(logdir, "**", "*.trace.json.gz"),
                       recursive=True)
     paths += glob.glob(os.path.join(logdir, "**", "*.trace.json"),
                        recursive=True)
-    totals: Dict[str, float] = collections.defaultdict(float)
-    counts: Dict[str, int] = collections.defaultdict(int)
     for path in paths:
         opener = gzip.open if path.endswith(".gz") else open
         try:
@@ -135,7 +135,23 @@ def parse_trace_dir(logdir: str, *, device_only: bool = True
                     or name.isdigit()):  # bare step-number lanes
                 continue
             pool.append(ev)
-        for ev in _leaf_events(pool):
+        leaves = _leaf_events(pool)
+        if leaves:
+            yield leaves
+
+
+def parse_trace_dir(logdir: str, *, device_only: bool = True
+                    ) -> List[OpTime]:
+    """Aggregate complete ('X') events from every ``*.trace.json.gz``
+    under ``logdir`` into per-name totals, device timeline only (pids
+    whose process name mentions a device) unless ``device_only=False``
+    or no device pids exist (then: every non-metadata timeline).  Only
+    *leaf* events count — containers (step lanes, module spans) hold
+    their children's time and would double-count."""
+    totals: Dict[str, float] = collections.defaultdict(float)
+    counts: Dict[str, int] = collections.defaultdict(int)
+    for leaves in _trace_leaf_groups(logdir, device_only=device_only):
+        for ev in leaves:
             name = ev["name"]
             totals[name] += float(ev.get("dur", 0.0)) / 1e3  # us -> ms
             counts[name] += 1
@@ -145,6 +161,207 @@ def parse_trace_dir(logdir: str, *, device_only: bool = True
            for n, t in totals.items()]
     out.sort(key=lambda o: -o.total_ms)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Phase classification + exposed-collective overlap (ISSUE 9 tentpole)
+# ---------------------------------------------------------------------------
+
+#: The closed phase vocabulary :func:`classify_op` maps device ops into.
+#: ``matmul`` — MXU contractions (dot/convolution, and fusions whose HLO
+#: body contains contraction flops); ``vector`` — everything elementwise
+#: / VPU (the default bucket); ``collective`` — inter-chip communication;
+#: ``copy`` — on-chip copies and D2D moves; ``infeed`` — host<->device
+#: transfer (infeed/outfeed/send/recv); ``custom`` — opaque custom calls,
+#: i.e. the handwritten Pallas kernels.
+PHASES = ("matmul", "vector", "collective", "copy", "infeed", "custom")
+
+def _opcode_re(opcodes, *, async_pair: bool = False):
+    """ANCHORED instruction-name matcher: the opcode, an optional
+    ``-start``/``-done`` (async pairs), then nothing or an HLO
+    ``.suffix``.  Anchoring matters: CPU traces without device lanes
+    leak XLA *compiler pass* rows (``all-reduce-promotion``,
+    ``reduce-scatter-decomposer``) whose names merely start with a
+    collective opcode — a bare prefix match would manufacture fake
+    collective (and thus exposed-collective) time out of compile
+    passes."""
+    alts = "|".join(re.escape(o) for o in opcodes)
+    tail = r"(-start|-done)?" if async_pair else ""
+    return re.compile(r"^(?:%s)%s(\.\S*)?$" % (alts, tail))
+
+
+_COLLECTIVE_RE = _opcode_re(
+    ("all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+     "all-to-all", "collective-broadcast", "ragged-all-to-all"),
+    async_pair=True)
+_MATMUL_RE = _opcode_re(("dot", "dot-general", "convolution"))
+_COPY_RE = _opcode_re(("copy",), async_pair=True)
+_INFEED_RE = _opcode_re(
+    ("infeed", "outfeed", "send", "recv", "host-transfer"),
+    async_pair=True)
+_CUSTOM_RE = _opcode_re(("custom-call", "tpu_custom_call"))
+
+
+def classify_op(name: str, *, flops_map: Optional[Dict[str, tuple]] = None
+                ) -> str:
+    """Phase of one device op by its HLO instruction name.
+
+    Anchored opcode rules cover the unambiguous cases (an async
+    ``-start``/``-done`` pair classifies with its opcode:
+    ``all-gather-start.3`` is a collective; a compiler-pass row like
+    ``all-reduce-promotion`` is NOT).  Fusions are the ambiguous case —
+    ``fusion.12`` says nothing — so when ``flops_map`` (the output of
+    :func:`hlo_fusion_flops` for the same program) is supplied, a fusion
+    with contraction flops classifies ``matmul`` and a flopless one
+    ``vector``; without HLO text every fusion is ``vector`` (the
+    conservative read: unattributed compute never inflates the MXU
+    share).  Unmatched names default to ``vector``."""
+    n = name.lower()
+    if n.startswith("%"):
+        n = n[1:]
+    if _COLLECTIVE_RE.match(n):
+        return "collective"
+    if _CUSTOM_RE.match(n) or "mosaic" in n or "pallas" in n:
+        return "custom"
+    if _MATMUL_RE.match(n):
+        return "matmul"
+    if _COPY_RE.match(n):
+        return "copy"
+    if _INFEED_RE.match(n):
+        return "infeed"
+    if flops_map:
+        hit = flops_map.get(name) or flops_map.get(name.split("(")[0])
+        if hit is not None and hit[0] > 0:
+            return "matmul"
+    return "vector"
+
+
+def _merge_intervals(iv: List[tuple]) -> List[tuple]:
+    """Union of [start, end) intervals as a sorted disjoint list."""
+    out: List[tuple] = []
+    for s, e in sorted(i for i in iv if i[1] > i[0]):
+        if out and s <= out[-1][1]:
+            if e > out[-1][1]:
+                out[-1] = (out[-1][0], e)
+        else:
+            out.append((s, e))
+    return out
+
+
+def _uncovered_length(a: List[tuple], b: List[tuple]) -> float:
+    """Total length of ``a`` NOT covered by ``b`` (both already merged
+    disjoint sorted interval lists) — the exposed-collective core."""
+    total = 0.0
+    j = 0
+    for s, e in a:
+        cur = s
+        while j < len(b) and b[j][1] <= cur:
+            j += 1
+        k = j
+        while cur < e:
+            if k >= len(b) or b[k][0] >= e:
+                total += e - cur
+                break
+            bs, be = b[k]
+            if bs > cur:
+                total += min(bs, e) - cur
+            cur = max(cur, be)
+            k += 1
+    return total
+
+
+@dataclasses.dataclass
+class PhaseReport:
+    """Where a captured window's device milliseconds went.
+
+    ``phase_ms`` sums leaf-op durations per phase (lanes run
+    concurrently, so the phases can sum past ``span_ms``).
+    ``collective_ms`` is the *union* wall of all collective intervals;
+    ``exposed_collective_ms`` is the part of that union during which NO
+    compute (matmul/vector/custom) op was running anywhere on the
+    device timeline — the serialization cost overlap-aware ZeRO
+    (ROADMAP item 3) exists to remove, measured rather than inferred."""
+
+    phase_ms: Dict[str, float]
+    exposed_collective_ms: float
+    collective_ms: float
+    total_ms: float          # sum of all leaf-op durations
+    span_ms: float           # timeline extent (first start -> last end)
+    n_ops: int
+    top_ops: List[OpTime]
+
+    def to_payload(self) -> Dict[str, Any]:
+        """JSON-ready payload for the telemetry ``profile`` event."""
+        return {
+            "phase_ms": {k: round(v, 3) for k, v in self.phase_ms.items()},
+            "exposed_collective_ms": round(self.exposed_collective_ms, 3),
+            "collective_ms": round(self.collective_ms, 3),
+            "total_device_ms": round(self.total_ms, 3),
+            "span_ms": round(self.span_ms, 3),
+            "n_ops": self.n_ops,
+            "top_ops": [{"name": o.name, "ms": round(o.total_ms, 3),
+                         "calls": o.calls} for o in self.top_ops],
+        }
+
+
+def phase_report(logdir: str, *, hlo_text: Optional[str] = None,
+                 top: int = 5, device_only: bool = True) -> PhaseReport:
+    """Classify every leaf device op in a captured trace into
+    :data:`PHASES` and run the timeline overlap analysis.
+
+    ``hlo_text`` (``compiled.as_text()`` of the profiled program) lets
+    fusions classify as matmul-vs-vector by their contraction content;
+    without it fusions count as ``vector``.
+
+    Exposed-collective method: merge all collective leaf intervals into
+    a union, merge all compute (matmul/vector/custom) leaf intervals
+    into a union — across every lane, since a collective on one lane is
+    hidden by compute on any other — and measure the collective union
+    length left uncovered.  Timestamps are only comparable within one
+    trace file, so the analysis runs per file and sums."""
+    flops_map = hlo_fusion_flops(hlo_text) if hlo_text else None
+    phase_ms: Dict[str, float] = {p: 0.0 for p in PHASES}
+    totals: Dict[str, float] = collections.defaultdict(float)
+    counts: Dict[str, int] = collections.defaultdict(int)
+    exposed_us = coll_us = span_us = 0.0
+    n_ops = 0
+    for leaves in _trace_leaf_groups(logdir, device_only=device_only):
+        coll_iv, compute_iv = [], []
+        lo = hi = None
+        for ev in leaves:
+            name = ev["name"]
+            dur = float(ev.get("dur", 0.0))
+            ts = float(ev.get("ts", 0.0))
+            phase = classify_op(name, flops_map=flops_map)
+            phase_ms[phase] += dur / 1e3
+            totals[name] += dur / 1e3
+            counts[name] += 1
+            n_ops += 1
+            lo = ts if lo is None else min(lo, ts)
+            hi = ts + dur if hi is None else max(hi, ts + dur)
+            if phase == "collective":
+                coll_iv.append((ts, ts + dur))
+            elif phase in ("matmul", "vector", "custom"):
+                compute_iv.append((ts, ts + dur))
+        coll_u = _merge_intervals(coll_iv)
+        comp_u = _merge_intervals(compute_iv)
+        coll_us += sum(e - s for s, e in coll_u)
+        exposed_us += _uncovered_length(coll_u, comp_u)
+        if lo is not None:
+            span_us += hi - lo
+    grand = sum(totals.values()) or 1.0
+    ranked = sorted(totals, key=lambda n: -totals[n])[:top]
+    top_ops = [OpTime(name=n, total_ms=totals[n], calls=counts[n],
+                      frac_of_device=totals[n] / grand) for n in ranked]
+    return PhaseReport(
+        phase_ms={k: v for k, v in phase_ms.items() if v > 0},
+        exposed_collective_ms=exposed_us / 1e3,
+        collective_ms=coll_us / 1e3,
+        total_ms=sum(totals.values()),
+        span_ms=span_us / 1e3,
+        n_ops=n_ops,
+        top_ops=top_ops,
+    )
 
 
 def top_ops_report(fn: Callable, *args, steps: int = 3,
@@ -351,15 +568,58 @@ def hlo_fusion_flops(hlo_text: str) -> Dict[str, tuple]:
     return out
 
 
+def flash_attention_flops(batch_heads: int, seq: int, head_dim: int, *,
+                          causal: bool = False,
+                          backward: bool = False) -> float:
+    """Analytic matmul flops of one flash-attention invocation — the
+    documented per-op override for the 5×-under-report caveat
+    (docs/profiling.md): XLA cost analysis and the HLO flops parser both
+    see a Pallas custom call as opaque (flops 0), but the kernel's
+    contraction content is exactly two s×s×d matmuls (qkᵀ and pv) per
+    (batch, head) row forward — 2.5× that fwd+bwd (dq, dk, dv plus the
+    recomputed score matmuls).  ``causal`` halves the density."""
+    f = 2 * 2 * batch_heads * seq * seq * head_dim
+    if causal:
+        f /= 2
+    return f * 2.5 if backward else f
+
+
+def _override_flops(name: str, op_name: str,
+                    overrides: Optional[Dict[str, float]]) -> Optional[float]:
+    """Per-call analytic flops for an op whose HLO content is opaque:
+    the first ``overrides`` key found as a substring of the op_name
+    metadata (the jax trace path — where kernel identity lives) or the
+    instruction name wins."""
+    if not overrides:
+        return None
+    for pat, fl in overrides.items():
+        if pat in op_name or pat in name:
+            return float(fl)
+    return None
+
+
 def join_roofline(ops: Sequence[OpTime], hlo_text: str,
-                  roof_tflops: Optional[float] = None) -> List[dict]:
+                  roof_tflops: Optional[float] = None,
+                  flop_overrides: Optional[Dict[str, float]] = None
+                  ) -> List[dict]:
     """pyprof prof/output.py parity (measured time JOINED with derived
     flops): each measured op gains estimated flops, achieved TFLOPS, and
-    fraction-of-roof.  Ops with no matmul/conv content get flops 0."""
+    fraction-of-roof.  Ops with no matmul/conv content get flops 0 —
+    unless ``flop_overrides`` ({op_name substring: analytic flops per
+    call}) supplies the number the HLO can't: Pallas custom calls are
+    opaque to the flops parser, so a flash-attention row would otherwise
+    read 0 flops and the 5× under-report caveat applies.  Overridden
+    rows carry ``"flops_src": "override"`` so the provenance is in the
+    record, not just the method."""
     fl = hlo_fusion_flops(hlo_text)
     rows = []
     for o in ops:
         f, nbytes, op_name = fl.get(o.name, (0.0, 0.0, ""))
+        overridden = False
+        if f == 0.0:
+            ov = _override_flops(o.name, op_name, flop_overrides)
+            if ov is not None:
+                f, overridden = ov, True
         t = o.total_ms / max(o.calls, 1) / 1e3
         tf = f / t / 1e12 if t > 0 else 0.0
         row = {"name": o.name, "ms": round(o.total_ms / max(o.calls, 1), 3),
@@ -373,6 +633,8 @@ def join_roofline(ops: Sequence[OpTime], hlo_text: str,
         if op_name:
             # keep the informative tail (op + source), not the jit prefix
             row["op"] = op_name[-80:]
+        if overridden:
+            row["flops_src"] = "override"
         if roof_tflops:
             row["frac_of_roof"] = round(tf / roof_tflops, 3)
         rows.append(row)

@@ -537,6 +537,7 @@ def run_elastic_training(
     log_fn: Optional[Callable[[str], None]] = None,
     telemetry=None,
     telemetry_scalars=None,
+    profile_sampler=None,
 ):
     """Drive ZeRO training across device loss.
 
@@ -614,7 +615,10 @@ def run_elastic_training(
     the bus's mesh topology with the survivor submesh so post-recovery
     events are attributable to the shrunken mesh.  The inner loop's
     exception path has already flushed a ``postmortem_*.jsonl`` by the
-    time the rebuild starts.
+    time the rebuild starts.  ``profile_sampler`` (ISSUE 9) rides into
+    the inner loop unchanged, so phase/collective/HBM attribution keeps
+    sampling across rebuilds — post-recovery ``profile`` events carry
+    the survivor mesh stamp.
     """
     from apex_tpu.checkpoint.checkpoint import (_complete_steps,
                                                 load_data_state)
@@ -693,7 +697,8 @@ def run_elastic_training(
                 handler=handler, guard=guard, watchdog=watchdog,
                 start_step=step, on_step=on_step,
                 log_every=log_every, log_fn=log_fn,
-                telemetry=telemetry, telemetry_scalars=telemetry_scalars)
+                telemetry=telemetry, telemetry_scalars=telemetry_scalars,
+                profile_sampler=profile_sampler)
             loop_results.append(result)
             return ElasticResult(
                 state=result.state, step=result.step, restarts=restarts,

@@ -16,8 +16,14 @@ capture, per-op attribution) is :mod:`apex_tpu.profiling`:
   escalation, or device loss (``bus.flush_postmortem``);
 - **schema** — :func:`validate_event` / :func:`validate_jsonl`, the
   CI-side contract every producer is tested against;
+- **sampler** — :class:`ProfileSampler` (ISSUE 9): periodic in-run
+  capture + phase/collective/HBM attribution through the bus
+  (``profile``/``memory`` events), overhead booked to its own goodput
+  bucket and budget-bounded ≤1%;
 - **CLI** — ``python -m apex_tpu.telemetry summarize run.jsonl``
-  (p50/p95/p99 step time, goodput %, event counts, ``--diff`` A/B).
+  (p50/p95/p99 step time, goodput %, phase breakdown, event counts,
+  ``--diff`` A/B; ``regress A.json B.json --max-regress PCT`` — the
+  BENCH-record CI gate).
 
 See ``docs/telemetry.md`` for the event schema and wiring examples.
 """
@@ -37,6 +43,11 @@ from apex_tpu.telemetry.bus import (  # noqa: F401
     install_recompile_listener,
 )
 from apex_tpu.telemetry.recorder import FlightRecorder  # noqa: F401
+from apex_tpu.telemetry.sampler import (  # noqa: F401
+    JaxProfilerTracer,
+    ProfileSampler,
+    device_memory_payload,
+)
 from apex_tpu.telemetry.schema import (  # noqa: F401
     SchemaError,
     load_jsonl,
@@ -66,6 +77,9 @@ __all__ = [
     "format_diff",
     "format_summary",
     "install_recompile_listener",
+    "JaxProfilerTracer",
+    "ProfileSampler",
+    "device_memory_payload",
     "load_jsonl",
     "summarize_events",
     "summarize_file",

@@ -3,11 +3,17 @@
 Subcommands:
 
 - ``summarize RUN.jsonl`` — step-time p50/p95/p99, goodput %, time
-  buckets, per-event-type counts.  ``--diff OTHER.jsonl`` renders an
-  A/B table instead (RUN is the A/baseline column).  ``--json`` emits
-  the raw summary record(s) for tooling.
+  buckets, phase breakdown (when the run sampled profiles), per-event-
+  type counts.  ``--diff OTHER.jsonl`` renders an A/B table instead
+  (RUN is the A/baseline column).  ``--json`` emits the raw summary
+  record(s) for tooling.
 - ``validate FILE.jsonl`` — schema-check every event (exit 1 on the
   first violation); works on run streams and postmortem files alike.
+- ``regress A.json B.json --max-regress PCT`` — BENCH-record CI gate
+  (ISSUE 9): compares two committed ``BENCH_r*.json`` key files with
+  per-key direction rules and exits 1 when any gated key regressed
+  more than PCT percent (``--keys`` restricts and makes the named keys
+  mandatory; ``--verbose`` prints every compared row).
 """
 
 from __future__ import annotations
@@ -37,7 +43,48 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                            help="schema-check every event in a file")
     p_val.add_argument("jsonl")
 
+    p_reg = sub.add_parser(
+        "regress", help="BENCH-record regression gate (exit 1 on a "
+                        "gated-key regression beyond --max-regress)")
+    p_reg.add_argument("a", help="baseline BENCH_r*.json (A)")
+    p_reg.add_argument("b", help="candidate BENCH_r*.json (B)")
+    p_reg.add_argument("--max-regress", type=float, default=5.0,
+                       metavar="PCT",
+                       help="tolerated regression percent on any gated "
+                            "key (default 5)")
+    p_reg.add_argument("--keys", default=None,
+                       help="comma-separated exact keys to gate "
+                            "(missing key = failure); default: every "
+                            "gated key present in both files")
+    p_reg.add_argument("--json", action="store_true",
+                       help="emit the comparison rows as JSON")
+    p_reg.add_argument("--verbose", action="store_true",
+                       help="print every compared row, not just "
+                            "failures")
+
     args = parser.parse_args(argv)
+
+    if args.cmd == "regress":
+        from apex_tpu.telemetry.regress import (
+            compare_bench, format_regress, load_bench_keys)
+
+        try:
+            ka, kb = load_bench_keys(args.a), load_bench_keys(args.b)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"ERROR: {e}", file=sys.stderr)
+            return 2
+        keys = ([k.strip() for k in args.keys.split(",") if k.strip()]
+                if args.keys else None)
+        rows, failures = compare_bench(ka, kb, args.max_regress, keys=keys)
+        if args.json:
+            print(json.dumps({"max_regress_pct": args.max_regress,
+                              "rows": rows,
+                              "failures": [r["key"] for r in failures]},
+                             indent=1))
+        else:
+            print(format_regress(rows, failures, args.max_regress,
+                                 verbose=args.verbose))
+        return 1 if failures else 0
 
     if args.cmd == "validate":
         from apex_tpu.telemetry.schema import SchemaError, validate_jsonl

@@ -25,9 +25,11 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, Optional
 
-#: Non-step time buckets ``pause`` accepts.
+#: Non-step time buckets ``pause`` accepts.  ``profile`` is the
+#: ProfileSampler's own capture/parse overhead (ISSUE 9) — booked so
+#: goodput stays honest about what observability itself costs.
 PAUSE_KINDS = ("ckpt_fence", "restore", "rebuild", "compile", "data_wait",
-               "other")
+               "profile", "other")
 
 
 def _to_scalar(v: Any):

@@ -60,6 +60,10 @@ EVENT_TYPES = frozenset({
                        # per-request TTFT/TPOT latency record
     "decode_step",     # serving: one continuous-batching decode step
                        # (batch width, tokens, page-pool occupancy)
+    "profile",         # ProfileSampler window: per-phase device ms,
+                       # exposed-collective ms, top-k ops (ISSUE 9)
+    "memory",          # ProfileSampler HBM sample: live/peak bytes from
+                       # device_memory_stats (absent fields = no stats)
 })
 
 

@@ -1,0 +1,185 @@
+"""BENCH-record regression gate: ``python -m apex_tpu.telemetry regress``.
+
+The repo's perf trajectory is a sequence of committed ``BENCH_r*.json``
+records (the driver's capture of ``bench.py``'s summary line).  Until
+now comparing two of them was a human task; this module makes it an
+exit-code CI gate:
+
+    python -m apex_tpu.telemetry regress BENCH_r04.json BENCH_r05.json \\
+        --max-regress 10
+
+loads both records, pairs every numeric key present in both, decides
+per key whether higher or lower is better (suffix/substring rules over
+the repo's established key vocabulary — ``*_per_sec`` up, ``*_ms``
+down, ...), and exits 1 if any *gated* key moved in the losing
+direction by more than ``--max-regress`` percent.  Keys matching no
+direction rule (batch sizes, config echoes, counters) are reported but
+never gated — a gate that guesses directions would manufacture
+failures.
+
+Accepted file shapes: the driver's wrapped capture
+(``{"parsed": {"metric", "value", "extras": {...}}}``), bench.py's raw
+summary line (``{"metric", "value", "extras": {...}}``), or a flat
+``{key: number}`` dict — so the gate also works on ad-hoc key files.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["load_bench_keys", "key_direction", "compare_bench",
+           "format_regress", "GATED_LOWER", "GATED_HIGHER"]
+
+#: Lower-is-better key patterns (regex, searched): latency, wait,
+#: skip/stall counts, memory peaks, exposed communication.
+GATED_LOWER = (
+    r"_ms$", r"_ms_p\d+$", r"_ms_per_step$", r"tpot", r"ttft",
+    r"_wait_ms", r"_hbm_peak_gb$", r"peak_hbm_gb$", r"_hbm_gb$",
+    r"exposed_collective_ms$", r"_phase_collective_ms$",
+)
+
+#: Higher-is-better key patterns: throughput, efficiency, rooflines.
+GATED_HIGHER = (
+    r"_per_sec$", r"_tflops$", r"_mfu", r"goodput$", r"_speedup",
+    r"_gb_s$", r"frac_of_roof$", r"frac_of_dot_floor$", r"_min_ratio$",
+)
+
+
+def key_direction(key: str) -> Optional[str]:
+    """``"higher"`` / ``"lower"`` when the key matches a gated pattern,
+    None for informational keys the gate must not guess about."""
+    for pat in GATED_LOWER:
+        if re.search(pat, key):
+            return "lower"
+    for pat in GATED_HIGHER:
+        if re.search(pat, key):
+            return "higher"
+    return None
+
+
+def _flatten(prefix: str, obj: Any, out: Dict[str, float]) -> None:
+    if isinstance(obj, bool):
+        return  # booleans are claims, not magnitudes
+    if isinstance(obj, (int, float)):
+        out[prefix] = float(obj)
+    elif isinstance(obj, dict):
+        for k, v in obj.items():
+            _flatten(f"{prefix}.{k}" if prefix else str(k), v, out)
+
+
+def load_bench_keys(path: str) -> Dict[str, float]:
+    """Flat {key: number} view of one BENCH record file (see module
+    docstring for the accepted shapes).  Nested dict entries flatten
+    with dotted keys (``flash_attention_s4096.fwd_tflops``), so kernel
+    sub-records gate too."""
+    with open(path) as f:
+        rec = json.load(f)
+    if isinstance(rec, dict) and "parsed" in rec:
+        rec = rec["parsed"]
+        if not isinstance(rec, dict):
+            # the r4 incident: a driver capture whose summary line did
+            # not parse.  Gating against it would compare nothing and
+            # exit green — refuse instead.
+            raise ValueError(
+                f"{path}: driver capture has parsed={rec!r} (truncated "
+                "summary line) — no keys to gate against")
+    out: Dict[str, float] = {}
+    if isinstance(rec, dict) and "extras" in rec:
+        _flatten("", rec.get("extras") or {}, out)
+        # the headline rides under its metric name so the suffix rules
+        # apply to it like any other key
+        if isinstance(rec.get("value"), (int, float)) and rec.get("metric"):
+            out[str(rec["metric"])] = float(rec["value"])
+    elif isinstance(rec, dict):
+        _flatten("", rec, out)
+    else:
+        raise ValueError(f"{path}: not a BENCH record (dict expected)")
+    return out
+
+
+def compare_bench(a: Dict[str, float], b: Dict[str, float],
+                  max_regress_pct: float,
+                  keys: Optional[Sequence[str]] = None
+                  ) -> Tuple[List[dict], List[dict]]:
+    """Pair the two key sets; returns ``(rows, failures)``.
+
+    Each row: key, a, b, delta_pct (B vs A in the key's *good*
+    direction: positive = improved), direction (or None), gated, ok.
+    ``failures`` are the gated rows whose regression exceeds
+    ``max_regress_pct``.  ``keys`` restricts the comparison (exact
+    names); a requested key missing from either file is itself a
+    failure — a gate that silently skips a vanished headline key is no
+    gate."""
+    rows: List[dict] = []
+    failures: List[dict] = []
+    names = sorted(set(a) & set(b)) if keys is None else list(keys)
+    for k in names:
+        va, vb = a.get(k), b.get(k)
+        if va is None or vb is None:
+            row = {"key": k, "a": va, "b": vb, "direction": None,
+                   "gated": True, "ok": False,
+                   "error": "missing from " + ("A" if va is None else "B")}
+            rows.append(row)
+            failures.append(row)
+            continue
+        direction = key_direction(k)
+        if direction is None:
+            change = None
+        elif va:
+            change = ((vb - va) if direction == "higher" else (va - vb)) \
+                / abs(va) * 100.0
+        elif vb == va:
+            change = 0.0
+        else:
+            # moved off a 0.0 baseline: percent is undefined, but the
+            # gate must not go blind — e.g. exposed_collective_ms
+            # 0.0 -> 50.0 is an unbounded regression, not a 0% change
+            worse = (vb < va) if direction == "higher" else (vb > va)
+            change = float("-inf") if worse else float("inf")
+        gated = direction is not None
+        ok = (not gated) or change is None or change >= -max_regress_pct
+        row = {"key": k, "a": va, "b": vb, "delta_pct": change,
+               "direction": direction, "gated": gated, "ok": ok}
+        rows.append(row)
+        if not ok:
+            failures.append(row)
+    return rows, failures
+
+
+def format_regress(rows: List[dict], failures: List[dict],
+                   max_regress_pct: float, *,
+                   verbose: bool = False) -> str:
+    """Human-readable gate report: failures first, then (``verbose``)
+    every gated row; informational keys only with ``verbose``."""
+    lines = []
+
+    def fmt(row):
+        d = {"higher": "↑", "lower": "↓", None: " "}[row["direction"]]
+        if row.get("error"):
+            return f"  {row['key']:<44} {row['error']}"
+        ch = row.get("delta_pct")
+        chs = f"{ch:+7.1f}%" if ch is not None else "    n/a"
+        return (f"  {row['key']:<44} {d} {row['a']:>12g} -> "
+                f"{row['b']:>12g}  {chs}")
+
+    if failures:
+        lines.append(f"REGRESSIONS (> {max_regress_pct:g}% in the losing "
+                     f"direction):")
+        lines += [fmt(r) for r in failures]
+    else:
+        lines.append(f"ok: no gated key regressed more than "
+                     f"{max_regress_pct:g}%")
+    gated = [r for r in rows if r["gated"] and not r.get("error")]
+    if gated:
+        worst = min((r["delta_pct"] for r in gated
+                     if r["delta_pct"] is not None), default=None)
+        lines.append(f"gated keys compared: {len(gated)}"
+                     + (f"  (worst move {worst:+.1f}%)"
+                        if worst is not None else ""))
+    if verbose:
+        for r in rows:
+            if r not in failures:
+                lines.append(fmt(r))
+    return "\n".join(lines)

@@ -1,0 +1,334 @@
+"""In-run performance attribution: the profiling<->telemetry bridge.
+
+The offline layer (:mod:`apex_tpu.profiling`) can say where a step's
+milliseconds went — but only in a manual TensorBoard session; the
+online layer (the PR 4 bus) records *that* p95 moved but not *why*.
+:class:`ProfileSampler` joins them (ISSUE 9): every N steps it captures
+a short profiler window around the live train step, runs the
+phase/collective/overlap classifier
+(:func:`apex_tpu.profiling.trace_report.phase_report`), and emits the
+result as typed ``profile`` and ``memory`` events through the bus — so
+a long-running job's stream answers "what fraction of the step is
+exposed collective wall, and what is HBM doing" without stopping the
+run.
+
+Two disciplines inherited from the PR 4 accounting:
+
+1. **Overhead is booked, not hidden.**  Every host second the sampler
+   spends (trace start/stop, parse, classify) goes to its own
+   ``profile`` accountant bucket, so goodput stays honest.
+2. **Overhead is bounded.**  The sampler tracks its own cost and
+   *defers* a scheduled capture whenever taking it would push total
+   sampler overhead past ``max_overhead`` (default 1%) of the run's
+   wall so far — the ≤1% bound is enforced by construction, not hoped
+   for (asserted in tests/L0/test_perf_attribution.py).
+
+The sampler must never kill the run it observes: every capture is
+wrapped; a failure increments ``failures``, remembers ``last_error``,
+and after ``max_failures`` consecutive failures the sampler disables
+itself (a broken profiler backend degrades to "no profile events", not
+a crashed job).
+"""
+
+from __future__ import annotations
+
+import logging
+import shutil
+import tempfile
+import time
+from typing import Any, Callable, Dict, Optional
+
+log = logging.getLogger("apex_tpu.telemetry")
+
+__all__ = ["ProfileSampler", "JaxProfilerTracer", "device_memory_payload"]
+
+
+class JaxProfilerTracer:
+    """Default capture backend: ``jax.profiler`` with the host/python
+    tracers OFF (the trace writer caps at ~1M events total and a
+    host-spammed window evicts the device timeline — the r5 incident
+    :mod:`apex_tpu.profiling.trace_report` documents)."""
+
+    def start(self, logdir: str) -> None:
+        import jax
+
+        try:
+            opts = jax.profiler.ProfileOptions()
+            opts.host_tracer_level = 0
+            opts.python_tracer_level = 0
+            jax.profiler.start_trace(logdir, profiler_options=opts)
+        except (AttributeError, TypeError):  # older jax: no options
+            jax.profiler.start_trace(logdir)
+
+    def stop(self) -> None:
+        import jax
+
+        jax.profiler.stop_trace()
+
+
+def device_memory_payload() -> Dict[str, Any]:
+    """Live/peak HBM sampled from ``device.memory_stats()`` across local
+    devices.  Backends without stats (CPU) report
+    ``stats_available=False`` with the byte fields ABSENT — optionality
+    is explicit in the schema, never smuggled via sentinel zeros."""
+    payload: Dict[str, Any] = {"stats_available": False, "n_devices": 0}
+    try:
+        import jax
+
+        devs = jax.local_devices()
+        payload["n_devices"] = len(devs)
+        live = peak = limit = 0
+        seen = False
+        for d in devs:
+            try:
+                st = d.memory_stats()
+            except Exception:
+                st = None
+            if not st:
+                continue
+            seen = True
+            live += int(st.get("bytes_in_use", 0))
+            peak += int(st.get("peak_bytes_in_use", 0))
+            limit += int(st.get("bytes_limit", 0))
+        if seen:
+            payload["stats_available"] = True
+            payload["live_bytes"] = live
+            payload["peak_bytes"] = peak
+            if limit:
+                payload["limit_bytes"] = limit
+    except Exception:  # pragma: no cover — jax not importable
+        pass
+    return payload
+
+
+class ProfileSampler:
+    """Periodic in-run phase/collective/HBM attribution sampler.
+
+    ``bus`` — the run's :class:`~apex_tpu.telemetry.TelemetryBus`.
+    ``every`` — capture cadence in steps (a window starts at each
+    multiple, budget permitting).  ``window`` — how many steps one
+    capture spans.  ``hlo_text`` — optional compiled-HLO text of the
+    profiled step (``jitted.lower(...).compile().as_text()``); with it
+    fusions classify matmul-vs-vector, without it they count as vector.
+    ``accountant`` — where overhead books (default: the bus's shared
+    accountant if one exists).  ``max_overhead`` — the budget fraction
+    (see module docstring).  ``tracer`` — capture backend with
+    ``start(logdir)``/``stop()`` (tests inject a synthetic one; default
+    :class:`JaxProfilerTracer`).
+
+    Train loops call :meth:`on_step` once per completed step
+    (``run_resilient_training(profile_sampler=...)`` does).  Benches
+    that have no step hook use :meth:`capture` around an explicit
+    window.  The latest parsed report stays on ``last_report``.
+    """
+
+    def __init__(self, bus, *, every: int = 50, window: int = 1,
+                 top_k: int = 5, max_overhead: float = 0.01,
+                 hlo_text: Optional[str] = None,
+                 accountant: Any = None,
+                 tracer: Any = None,
+                 max_failures: int = 3):
+        self.bus = bus
+        self.every = max(1, int(every))
+        self.window = max(1, int(window))
+        self.top_k = top_k
+        self.max_overhead = float(max_overhead)
+        self.hlo_text = hlo_text
+        self.tracer = tracer if tracer is not None else JaxProfilerTracer()
+        self.max_failures = max_failures
+        self._acct = accountant
+        self._now: Callable[[], float] = time.monotonic
+        self._t0: Optional[float] = None  # first on_step/capture
+        self._active_dir: Optional[str] = None
+        self._remaining = 0
+        self._capture_cost = 0.0  # host cost of the in-flight capture
+        self.overhead_s = 0.0
+        self.samples = 0
+        self.deferred = 0
+        self.failures = 0
+        self._consecutive_failures = 0
+        self.disabled = False
+        self.last_error: Optional[str] = None
+        self.last_report = None
+
+    # -- budget ----------------------------------------------------------
+
+    def wall(self) -> float:
+        if self._t0 is None:
+            return 0.0
+        return max(self._now() - self._t0, 1e-9)
+
+    def overhead_fraction(self) -> float:
+        """Sampler host-overhead as a fraction of the run wall observed
+        so far (0 before the first step)."""
+        if self._t0 is None:
+            return 0.0
+        return self.overhead_s / self.wall()
+
+    def attach_accountant(self, accountant) -> None:
+        """Give the sampler a :class:`StepAccountant` to book its
+        overhead against, unless the constructor already supplied one —
+        the train loops call this with the bus's shared ledger."""
+        if self._acct is None:
+            self._acct = accountant
+
+    def _budget_allows(self) -> bool:
+        """Would another capture (projected at the mean cost of the
+        captures so far) keep total overhead within ``max_overhead`` of
+        wall?  The first capture has no cost estimate and is always
+        allowed — the bound holds asymptotically, which is the regime a
+        *long-running* job's sampler lives in."""
+        if self.samples == 0:
+            return True
+        projected = self.overhead_s * (self.samples + 1) / self.samples
+        return projected <= self.max_overhead * self.wall()
+
+    # -- bookkeeping -----------------------------------------------------
+
+    def _book(self, seconds: float) -> None:
+        self.overhead_s += seconds
+        acct = self._acct
+        if acct is None:
+            acct = getattr(self.bus, "_accountant", None)
+        if acct is not None:
+            try:
+                acct.pause(seconds, "profile")
+            except Exception:  # pragma: no cover — old accountant
+                pass
+
+    def _fail(self, err: Exception) -> None:
+        self.failures += 1
+        self._consecutive_failures += 1
+        self.last_error = repr(err)[:200]
+        if self._consecutive_failures >= self.max_failures:
+            self.disabled = True
+            log.warning("ProfileSampler disabled after %d consecutive "
+                        "failures: %s", self._consecutive_failures,
+                        self.last_error)
+
+    # -- capture machinery -----------------------------------------------
+
+    def _start(self) -> None:
+        d = tempfile.mkdtemp(prefix="apex_tpu_sampler_")
+        try:
+            self.tracer.start(d)
+        except Exception:
+            shutil.rmtree(d, ignore_errors=True)
+            raise
+        self._active_dir = d
+        self._remaining = self.window
+
+    def _finish(self):
+        """Stop the active capture and classify it (no emission)."""
+        from apex_tpu.profiling.trace_report import phase_report
+
+        d, self._active_dir = self._active_dir, None
+        try:
+            self.tracer.stop()
+            report = phase_report(d, hlo_text=self.hlo_text,
+                                  top=self.top_k)
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+        self.last_report = report
+        self.samples += 1
+        return report
+
+    def _emit(self, step: Optional[int], report,
+              overhead_s: float) -> None:
+        payload = report.to_payload()
+        payload["window_steps"] = self.window
+        payload["overhead_ms"] = round(overhead_s * 1e3, 3)
+        self.bus.emit("profile", step=step, **payload)
+        self.bus.emit("memory", step=step, **device_memory_payload())
+
+    # -- public entry points ---------------------------------------------
+
+    def on_step(self, step: int) -> None:
+        """Call once per *completed* step.  Starts a capture at each
+        ``every`` multiple (budget permitting) and closes it after
+        ``window`` further steps.  Never raises."""
+        if self.disabled:
+            return
+        if self._t0 is None:
+            self._t0 = self._now()
+        try:
+            if self._active_dir is not None:
+                self._remaining -= 1
+                if self._remaining <= 0:
+                    t0 = self._now()
+                    report = self._finish()
+                    dt = self._now() - t0
+                    self._capture_cost += dt
+                    self._book(dt)
+                    self._emit(step, report, self._capture_cost)
+                    self._consecutive_failures = 0
+                return
+            if step % self.every == 0:
+                if not self._budget_allows():
+                    self.deferred += 1
+                    return
+                t0 = self._now()
+                self._start()
+                dt = self._now() - t0
+                self._capture_cost = dt  # start cost; finish adds parse
+                self._book(dt)
+        except Exception as e:
+            # observability must never kill the run it observes
+            self._abort_quietly()
+            self._fail(e)
+
+    def capture(self, run_window: Callable[[], Any], *,
+                step: Optional[int] = None):
+        """Explicit one-shot capture: trace ``run_window()`` (which
+        should run the already-warmed step(s) and sync), classify, emit
+        the ``profile``/``memory`` pair, book the overhead.  The whole
+        wall — window included — books as ``profile`` overhead: these
+        steps ran purely to be profiled (the bench entry point; a train
+        loop uses :meth:`on_step`, where only start/stop/parse book).
+        Returns the :class:`~apex_tpu.profiling.trace_report.
+        PhaseReport`, or None on failure (never raises)."""
+        if self.disabled:
+            return None
+        if self._t0 is None:
+            self._t0 = self._now()
+        t0 = self._now()
+        report = None
+        try:
+            self._start()
+            run_window()
+            report = self._finish()
+        except Exception as e:
+            self._abort_quietly()
+            self._fail(e)
+        finally:
+            dt = self._now() - t0
+            self._book(dt)  # booked exactly once, success or failure
+        if report is not None:
+            try:
+                self._emit(step, report, dt)
+                self._consecutive_failures = 0
+            except Exception as e:  # emit failure: no re-booking
+                self._fail(e)
+        return report
+
+    def _abort_quietly(self) -> None:
+        """Tear down a half-open capture without raising."""
+        d, self._active_dir = self._active_dir, None
+        self._remaining = 0
+        if d is not None:
+            try:
+                self.tracer.stop()
+            except Exception:
+                pass
+            shutil.rmtree(d, ignore_errors=True)
+
+    def totals(self) -> Dict[str, Any]:
+        """Sampler self-accounting for logs/records."""
+        return {
+            "samples": self.samples,
+            "deferred": self.deferred,
+            "failures": self.failures,
+            "overhead_s": round(self.overhead_s, 4),
+            "overhead_fraction": round(self.overhead_fraction(), 5),
+            "disabled": self.disabled,
+        }

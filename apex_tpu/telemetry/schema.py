@@ -64,6 +64,18 @@ PAYLOAD_REQUIRED: Dict[str, Dict[str, tuple]] = {
                        "new_tokens": (int,), "preemptions": (int,)},
     "decode_step": {"batch": (int,), "new_tokens": (int,),
                     "pool_used": (int,), "pool_pages": (int,)},
+    # in-run attribution (ISSUE 9): the ProfileSampler's window result.
+    # phase_ms maps phase -> device ms; exposed_collective_ms is the
+    # overlap-analysis headline; overhead_ms is the sampler's own host
+    # cost for this window (also booked to the `profile` goodput bucket)
+    "profile": {"window_steps": (int,), "phase_ms": (dict,),
+                "exposed_collective_ms": NUMBER,
+                "collective_ms": NUMBER, "total_device_ms": NUMBER,
+                "overhead_ms": NUMBER},
+    # HBM sample: stats_available is a REAL bool (bool-not-int
+    # discipline); live/peak/limit bytes are present only when the
+    # backend exposes memory_stats — optionality explicit, no sentinels
+    "memory": {"stats_available": (bool,), "n_devices": (int,)},
 }
 
 

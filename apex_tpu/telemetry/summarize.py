@@ -38,6 +38,10 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     tpot_ms: List[float] = []
     ttft_ms: List[float] = []
     pool_occ: List[float] = []
+    phase_ms: Dict[str, List[float]] = {}
+    exposed_ms: List[float] = []
+    profile_overhead_ms = 0.0
+    hbm_peak = None
     for ev in events:
         counts[ev.get("type", "?")] = counts.get(ev.get("type", "?"), 0) + 1
         t = ev.get("t")
@@ -59,6 +63,16 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             if ev.get("pool_pages"):
                 pool_occ.append(ev.get("pool_used", 0)
                                 / ev["pool_pages"])
+        elif ev.get("type") == "profile":
+            for k, v in (ev.get("phase_ms") or {}).items():
+                phase_ms.setdefault(k, []).append(float(v))
+            if ev.get("exposed_collective_ms") is not None:
+                exposed_ms.append(float(ev["exposed_collective_ms"]))
+            profile_overhead_ms += float(ev.get("overhead_ms", 0.0))
+        elif ev.get("type") == "memory":
+            if ev.get("peak_bytes") is not None:
+                pk = float(ev["peak_bytes"])
+                hbm_peak = pk if hbm_peak is None else max(hbm_peak, pk)
 
     s = sorted(step_ms)
     run_ids = list(dict.fromkeys(
@@ -96,6 +110,21 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                                    if sf else None)
         out["serving_pool_peak"] = (round(max(pool_occ), 4)
                                     if pool_occ else None)
+    if counts.get("profile"):
+        # phase attribution (ISSUE 9): mean per-phase device ms over the
+        # run's sampled windows — the answer to "where do a step's
+        # milliseconds go" on the same one-screen view that says the
+        # p95 moved
+        out["profile_samples"] = counts["profile"]
+        out["phase_ms"] = {
+            k: round(sum(v) / len(v), 3)
+            for k, v in sorted(phase_ms.items())}
+        out["exposed_collective_ms"] = (
+            round(sum(exposed_ms) / len(exposed_ms), 3)
+            if exposed_ms else None)
+        out["profile_overhead_ms"] = round(profile_overhead_ms, 3)
+    if hbm_peak is not None:
+        out["hbm_peak_gb"] = round(hbm_peak / 1e9, 3)
     if len(run_ids) > 1:
         # JsonlSink appends: a restarted job continues its stream file
         # under a new run_id.  Aggregating across runs is legitimate,
@@ -157,6 +186,15 @@ def format_summary(s: Dict[str, Any]) -> str:
         if s.get("serving_pool_peak") is not None:
             parts.append(f"pool peak {_pct(s['serving_pool_peak'])}")
         lines.append("  ".join(parts))
+    if s.get("profile_samples"):
+        parts = ["phases      " + "  ".join(
+            f"{k} {v:.2f}ms" for k, v in (s.get("phase_ms") or {}).items())]
+        if s.get("exposed_collective_ms") is not None:
+            parts.append(f"exposed coll {_ms(s['exposed_collective_ms'])}")
+        parts.append(f"({s['profile_samples']} samples)")
+        lines.append("  ".join(parts))
+    if s.get("hbm_peak_gb") is not None:
+        lines.append(f"hbm peak    {s['hbm_peak_gb']:.2f} GB")
     if s.get("data_stalls") or s.get("records_quarantined"):
         parts = [f"data        stalls {s.get('data_stalls', 0)}"]
         if s.get("records_quarantined"):
@@ -186,7 +224,18 @@ _DIFF_ROWS = (
     ("steps_per_sec", "steps/s", "{:.3f}"),
     ("data_stalls", "data stalls", "{:d}"),
     ("serving_tpot_p50", "tpot p50 (ms)", "{:.2f}"),
+    # phase-attribution rows (ISSUE 9): did the change move exposed
+    # communication or the memory high-water mark?
+    ("exposed_collective_ms", "exposed (ms)", "{:.2f}"),
+    ("hbm_peak_gb", "hbm peak (GB)", "{:.2f}"),
 )
+
+
+#: Per-phase diff rows are dynamic (phases present in either summary).
+def _phase_diff_rows(a: Dict[str, Any], b: Dict[str, Any]):
+    pa, pb = a.get("phase_ms") or {}, b.get("phase_ms") or {}
+    for k in sorted(set(pa) | set(pb)):
+        yield (k, pa.get(k), pb.get(k))
 
 
 def format_diff(a: Dict[str, Any], b: Dict[str, Any]) -> str:
@@ -209,4 +258,14 @@ def format_diff(a: Dict[str, Any], b: Dict[str, Any]) -> str:
         else:
             delta = "n/a"
         lines.append(f"{label:<12} {fa:>28} {fb:>28} {delta:>12}")
+    for phase, va, vb in _phase_diff_rows(a, b):
+        fa = f"{va:.2f}" if va is not None else "n/a"
+        fb = f"{vb:.2f}" if vb is not None else "n/a"
+        if va is not None and vb is not None:
+            delta = f"{vb - va:+.3f}"
+            if va:
+                delta += f" ({vb / va:.2f}x)"
+        else:
+            delta = "n/a"
+        lines.append(f"{'ph:' + phase:<12} {fa:>28} {fb:>28} {delta:>12}")
     return "\n".join(lines)

@@ -84,6 +84,7 @@ def run_resilient_training(
     log_fn: Optional[Callable[[str], None]] = None,
     telemetry: Any = None,
     telemetry_scalars: Optional[Callable[[Any], Dict[str, Any]]] = None,
+    profile_sampler: Any = None,
 ) -> LoopResult:
     """Run ``step_fn`` over ``batches`` with the full resilience wiring.
 
@@ -118,6 +119,14 @@ def run_resilient_training(
       grace-period exit and on any exception leaving the loop.
       ``telemetry_scalars(state) -> {name: device_ref}`` adds run-
       specific scalars (e.g. the loss) to the windowed batched fetch;
+    - ``profile_sampler``
+      (:class:`apex_tpu.telemetry.ProfileSampler`, ISSUE 9): gets
+      :meth:`~apex_tpu.telemetry.ProfileSampler.on_step` at every step
+      boundary, so the run periodically captures a short profiler
+      window and emits ``profile``/``memory`` attribution events
+      (per-phase device ms, exposed-collective ms, live/peak HBM)
+      through the bus; its capture overhead books to the accountant's
+      ``profile`` bucket.  The sampler never raises into the loop;
     - ``on_step(step)`` runs at each step boundary *before* the preemption
       poll (the chaos harness's ``SimulatedPreemption.poll`` and
       ``DeviceLoss.poll`` hook here);
@@ -181,6 +190,8 @@ def run_resilient_training(
             guard.telemetry = telemetry
         if watchdog is not None:
             telemetry.attach_watchdog(watchdog)
+        if profile_sampler is not None:
+            profile_sampler.attach_accountant(acct)
         telemetry.emit(
             "run_start", step=start_step,
             save_every=save_every, async_saves=bool(async_saves),
@@ -304,6 +315,10 @@ def run_resilient_training(
                                skipped=skipped, scalars=scalars,
                                compile_s=compile_s,
                                timing="synced" if synced else "dispatch")
+            if profile_sampler is not None:
+                # never raises: a broken profiler backend degrades to
+                # "no profile events", not a crashed run
+                profile_sampler.on_step(step)
             if log_every and step % log_every == 0:
                 _log()
             if on_step is not None:

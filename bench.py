@@ -392,6 +392,74 @@ def _bench_data_wait(bt, name, step_once, write_dataset, decode,
         shutil.rmtree(work, ignore_errors=True)
 
 
+def _bench_profile(bt, name, run_step, *, steps=2, hlo_fn=None):
+    """Phase/collective/HBM attribution sample for one flagship
+    workload (ISSUE 9): an explicit
+    :class:`~apex_tpu.telemetry.ProfileSampler` capture window around
+    ``steps`` already-warmed train steps, through the workload's
+    telemetry bus — so the bench stream carries the ``profile``/
+    ``memory`` events (``summarize`` renders the phase line; the
+    sampler-produced stream passes ``validate``), and the measured
+    split lands in BENCH keys:
+
+    - ``<name>_phase_{compute,collective,infeed}_ms`` — per-step device
+      ms in MXU/VPU/Pallas compute, inter-chip collectives, and
+      copy/infeed-outfeed respectively;
+    - ``<name>_exposed_collective_ms`` — collective wall NOT hidden by
+      concurrently-running compute (the overlap-aware-ZeRO gate's
+      "before" baseline, ROADMAP item 3);
+    - ``<name>_hbm_peak_gb`` — runtime peak HBM when the backend
+      exposes ``memory_stats`` (absent on backends without it).
+
+    ``run_step()`` runs one warmed step and syncs; ``hlo_fn()`` returns
+    the compiled step's HLO text (fusions then classify matmul-vs-
+    vector; without it they count as vector).  Failures degrade to an
+    error-marker key — attribution must never cost the record."""
+    try:
+        if bt._dead is not None:
+            return {}
+        from apex_tpu.telemetry import ProfileSampler, device_memory_payload
+
+        hlo = None
+        if hlo_fn is not None:
+            try:
+                hlo = hlo_fn()
+            except Exception:
+                hlo = None
+        samp = ProfileSampler(bt.bus, window=steps, accountant=bt.acct,
+                              hlo_text=hlo)
+
+        def window():
+            for _ in range(steps):
+                run_step()
+
+        rep = samp.capture(window, step=bt.step)
+        if rep is None:
+            return {f"{name}_profile_error":
+                    (samp.last_error or "capture produced no report")[:160]}
+        ph = rep.phase_ms
+
+        def per(ms):
+            return round(ms / steps, 3)
+
+        out = {
+            f"{name}_phase_compute_ms": per(
+                ph.get("matmul", 0.0) + ph.get("vector", 0.0)
+                + ph.get("custom", 0.0)),
+            f"{name}_phase_collective_ms": per(ph.get("collective", 0.0)),
+            f"{name}_phase_infeed_ms": per(
+                ph.get("copy", 0.0) + ph.get("infeed", 0.0)),
+            f"{name}_exposed_collective_ms": per(rep.exposed_collective_ms),
+            f"{name}_profile_overhead_ms": round(samp.overhead_s * 1e3, 1),
+        }
+        mem = device_memory_payload()
+        if mem.get("peak_bytes") is not None:
+            out[f"{name}_hbm_peak_gb"] = round(mem["peak_bytes"] / 1e9, 2)
+        return out
+    except Exception as e:  # pragma: no cover — defensive only
+        return {f"{name}_profile_error": repr(e)[:160]}
+
+
 # ---------------------------------------------------------------------------
 # Workloads
 # ---------------------------------------------------------------------------
@@ -521,8 +589,19 @@ def bench_resnet():
     data_keys = _bench_data_wait(bt, "resnet50", step_once, write_dataset,
                                  decode, BATCH, steps=2 if FAST else 6)
 
+    # ISSUE 9 attribution sample: the conv-vs-input-bound question gets
+    # a measured split (resnet50_phase_{compute,collective,infeed}_ms)
+    # instead of an inference from MFU
+    profile_keys = _bench_profile(
+        bt, "resnet50", lambda: step_once((x, y)),
+        steps=1 if FAST else 2,
+        hlo_fn=lambda: train_step.lower(
+            params, bn_state, opt_state, scale_state, x, y
+        ).compile().as_text())
+
     telemetry = bt.finish()
     telemetry.update(data_keys)
+    telemetry.update(profile_keys)
     return (ips, analytic_tflops, cost_tflops, final_loss, skipped,
             telemetry)
 
@@ -863,6 +942,23 @@ def bench_gpt1p3b(roof):
                                  decode, B, steps=2 if FAST else 4)
     params, opt_state = state_box["p"], state_box["o"]
 
+    # ISSUE 9 attribution sample: the ZeRO step's gather/scatter wall
+    # measured as exposed-collective ms — ROADMAP item 3's "before"
+    # baseline comes from here (gpt1p3b_exposed_collective_ms)
+    prof_box = {"p": params, "o": opt_state}
+
+    def _prof_step():
+        prof_box["p"], prof_box["o"], l = fs.step(
+            prof_box["p"], prof_box["o"], tokens, labels)
+        float(l)
+
+    profile_keys = _bench_profile(
+        bt, "gpt1p3b", _prof_step, steps=1 if FAST else 2,
+        hlo_fn=lambda: fs.step.lower(
+            prof_box["p"], prof_box["o"], tokens, labels
+        ).compile().as_text())
+    params, opt_state = prof_box["p"], prof_box["o"]
+
     out = {
         "gpt1p3b_batch": B,
         "gpt1p3b_fit_plan": plan,
@@ -883,6 +979,7 @@ def bench_gpt1p3b(roof):
     # the same stream offline)
     out.update(bt.finish())
     out.update(data_keys)
+    out.update(profile_keys)
 
     # device-clock step time (the relay's host dispatch gap distorts
     # wall; BASELINE.md r5 wall-vs-device note) — same closure pattern
@@ -1121,6 +1218,20 @@ def bench_bert_large(roof):
     out["bert_loss_first"] = round(first, 4)
     out["bert_loss_final"] = round(final, 4)
     out["bert_loss_decreasing"] = bool(final < first)
+
+    # ISSUE 9 attribution sample on the packed varlen step
+    prof_box = {"p": params, "o": opt_state}
+
+    def _prof_step():
+        prof_box["p"], prof_box["o"], l = step(prof_box["p"],
+                                               prof_box["o"], packed)
+        float(l)
+
+    out.update(_bench_profile(
+        bt, "bert_large", _prof_step, steps=1 if FAST else 2,
+        hlo_fn=lambda: step.lower(prof_box["p"], prof_box["o"],
+                                  packed).compile().as_text()))
+    params, opt_state = prof_box["p"], prof_box["o"]
     out.update(bt.finish())
 
     out["bert_padded_ms_per_step"] = round(t_pad * 1e3, 1)
@@ -1225,15 +1336,59 @@ def bench_serving():
     trace = poisson_trace(0, n_req, rate=rate, prompt_len=prompt_len,
                           max_new=max_new, vocab_size=V)
     t0 = time.perf_counter()
-    finished = eng.serve(trace)
+    # snapshot: serve() returns the scheduler's CUMULATIVE finished
+    # list, and the attribution mini-trace below appends to it — the
+    # headline request/token/preemption sums must cover the measured
+    # trace only
+    finished = list(eng.serve(trace))
     wall_s = time.perf_counter() - t0
+
+    # ISSUE 9 attribution sample: a short FRESH mini-trace (re-serving
+    # consumed requests is rejected by the engine) under the profiler —
+    # decode-phase device ms split + HBM peak ride the record, and the
+    # profile/memory events land in the same validated serving stream
+    profile_keys = {}
+    n_measured = len(mem.events)  # mini-trace events excluded from the
+    try:                          # headline percentile sums below
+        from apex_tpu.telemetry import ProfileSampler, device_memory_payload
+
+        samp = ProfileSampler(bus, window=1)
+        mini = poisson_trace(1, max(2, max_batch // 2), rate=rate,
+                             prompt_len=prompt_len, max_new=max_new,
+                             vocab_size=V)
+        rep = samp.capture(lambda: eng.serve(mini), step=None)
+        if rep is None:
+            profile_keys["serving_profile_error"] = (
+                samp.last_error or "capture produced no report")[:160]
+        else:
+            ph = rep.phase_ms
+            profile_keys = {
+                "serving_phase_compute_ms": round(
+                    ph.get("matmul", 0.0) + ph.get("vector", 0.0)
+                    + ph.get("custom", 0.0), 3),
+                "serving_phase_collective_ms": round(
+                    ph.get("collective", 0.0), 3),
+                "serving_phase_infeed_ms": round(
+                    ph.get("copy", 0.0) + ph.get("infeed", 0.0), 3),
+                "serving_exposed_collective_ms": round(
+                    rep.exposed_collective_ms, 3),
+            }
+        mem_stats = device_memory_payload()
+        if mem_stats.get("peak_bytes") is not None:
+            profile_keys["serving_hbm_peak_gb"] = round(
+                mem_stats["peak_bytes"] / 1e9, 2)
+    except Exception as e:
+        profile_keys["serving_profile_error"] = repr(e)[:160]
     bus.close()
 
     n_events = tel.validate_jsonl(stream)  # the acceptance contract
-    s = tel.summarize_events(mem.events)
-    decode_tokens = sum(ev.get("new_tokens", 0) for ev in mem.events
+    # the mini-trace's decode/admit/retire events would skew the
+    # headline latency percentiles: summarize only the measured trace
+    measured = mem.events[:n_measured]
+    s = tel.summarize_events(measured)
+    decode_tokens = sum(ev.get("new_tokens", 0) for ev in measured
                         if ev.get("type") == "decode_step")
-    decode_s = sum(ev.get("step_ms", 0.0) for ev in mem.events
+    decode_s = sum(ev.get("step_ms", 0.0) for ev in measured
                    if ev.get("type") == "decode_step") / 1e3
     total_tokens = sum(len(r.generated) for r in finished)
     return {
@@ -1251,6 +1406,7 @@ def bench_serving():
         "serving_compile_s": round(compile_s, 2),
         "serving_stream_events": n_events,
         "serving_telemetry_file": os.path.basename(stream),
+        **profile_keys,
         "serving_config": {
             "layers": L, "hidden": H, "heads": NH, "vocab": V,
             "dtype": "bf16", "page_size": page_size,
@@ -2107,9 +2263,14 @@ def main():
     # bench_schema 3 (r5): top-ops tables move to the BENCH_TOPOPS.json
     # sidecar and the summary line is size-guarded (_emit_record) so the
     # driver's tail capture always parses.
+    # bench_schema 4 (r9): every flagship carries an in-run attribution
+    # sample (`<name>_phase_{compute,collective,infeed}_ms`,
+    # `<name>_exposed_collective_ms`, `<name>_hbm_peak_gb`) captured by
+    # the telemetry ProfileSampler through the workload's stream; two
+    # records compare via `python -m apex_tpu.telemetry regress`.
     # The kernel-defaults CI gate (tests/L0/test_kernel_defaults.py)
     # enforces records with bench_schema >= 2.
-    extras["bench_schema"] = 3
+    extras["bench_schema"] = 4
 
     roof = attempt("matmul_roof", bench_matmul_roof)
     if roof is not None:

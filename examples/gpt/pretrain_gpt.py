@@ -86,6 +86,14 @@ def _extra_args(parser):
                         "a postmortem_*.jsonl flight-recorder dump on "
                         "preemption/escalation; summarize offline with "
                         "`python -m apex_tpu.telemetry summarize`")
+    g.add_argument("--profile-every", type=int, default=0,
+                   help="with --telemetry-dir: every N steps capture a "
+                        "short in-run profiler window and emit "
+                        "profile/memory attribution events (per-phase "
+                        "device ms, exposed-collective ms, live/peak "
+                        "HBM) into the stream; overhead is booked to "
+                        "the `profile` goodput bucket and bounded ≤1%; "
+                        "0 disables")
     return parser
 
 
@@ -255,7 +263,7 @@ def main(argv=None):
     # step events carry the data-wait/step wall split, the loss rides
     # the windowed batched fetch, and XLA recompiles are surfaced by
     # the jax monitoring listener
-    bus = acct = None
+    bus = acct = sampler = None
     compile_acc = {"s": 0.0}  # XLA compile wall since the last step
     uninstall_recompile = lambda: None  # noqa: E731
     if args.telemetry_dir:
@@ -271,6 +279,12 @@ def main(argv=None):
             bus, on_duration=lambda s: compile_acc.__setitem__(
                 "s", compile_acc["s"] + s))
         acct = bus.accountant(window=args.log_interval)
+        if args.profile_every > 0:
+            # in-run attribution (ISSUE 9): periodic phase/collective/
+            # HBM sampling through the same stream; `summarize` then
+            # renders the phase breakdown next to the step percentiles
+            sampler = tele.ProfileSampler(bus, every=args.profile_every,
+                                          accountant=acct)
         bus.emit("run_start", step=step0, workload="pretrain_gpt",
                  config={"num_layers": args.num_layers,
                          "hidden_size": args.hidden_size,
@@ -365,6 +379,8 @@ def main(argv=None):
                                    scalars={"loss": loss},
                                    compile_s=compile_s,
                                    timing="synced")
+                if sampler is not None:
+                    sampler.on_step(it + 1)  # never raises into the run
                 if (it + 1) % args.log_interval == 0:
                     dt = (time.perf_counter() - t0) / args.log_interval
                     tok_s = args.global_batch_size * args.seq_length / dt

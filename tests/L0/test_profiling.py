@@ -158,6 +158,292 @@ class TestTraceReport:
             assert o.total_ms >= 0 and o.calls >= 1
 
 
+class TestClassifyOp:
+    """ISSUE 9 tentpole: HLO-opcode -> phase classification."""
+
+    @pytest.mark.parametrize("name,phase", [
+        ("all-reduce.1", "collective"),
+        ("all-gather-start.3", "collective"),
+        ("all-gather-done.3", "collective"),
+        ("reduce-scatter.7", "collective"),
+        ("collective-permute.2", "collective"),
+        ("all-to-all.4", "collective"),
+        ("dot.3", "matmul"),
+        ("dot-general.1", "matmul"),
+        ("convolution.19", "matmul"),
+        ("copy.5", "copy"),
+        ("copy-start.1", "copy"),
+        ("infeed.0", "infeed"),
+        ("outfeed.2", "infeed"),
+        ("custom-call.9", "custom"),
+        ("fusion.12", "vector"),     # no HLO text: conservative
+        ("add.77", "vector"),
+        ("reduce.3", "vector"),
+        # XLA compiler-pass rows (leaked by CPU traces without device
+        # lanes) must NOT fake collective/matmul time — anchored match
+        ("all-reduce-promotion", "vector"),
+        ("reduce-scatter-decomposer", "vector"),
+        ("all_to_all_decomposer", "vector"),
+        ("dot_merger", "vector"),
+        ("copy-insertion", "vector"),
+    ])
+    def test_prefix_rules(self, name, phase):
+        from apex_tpu.profiling import classify_op
+
+        assert classify_op(name) == phase
+
+    def test_fusion_with_contraction_flops_classifies_matmul(self):
+        """A fusion is ambiguous by name; joined with the program's HLO
+        (hlo_fusion_flops) a contraction-bearing fusion becomes matmul
+        while a flopless one stays vector."""
+        from apex_tpu.profiling import classify_op
+        from apex_tpu.profiling.trace_report import hlo_fusion_flops
+
+        hlo = """
+%fused_computation.1 (p0: f32[64,32], p1: f32[32,48]) -> f32[64,48] {
+  %p0 = f32[64,32]{1,0} parameter(0)
+  %p1 = f32[32,48]{1,0} parameter(1)
+  ROOT %d = f32[64,48]{1,0} dot(%p0, %p1), lhs_contracting_dims={1}
+}
+%fused_computation.2 (p0: f32[64]) -> f32[64] {
+  %p0 = f32[64]{0} parameter(0)
+  ROOT %a = f32[64]{0} add(%p0, %p0)
+}
+ENTRY %main (x: f32[64,32], y: f32[32,48]) -> f32[64,48] {
+  %x = f32[64,32]{1,0} parameter(0)
+  %y = f32[32,48]{1,0} parameter(1)
+  %fusion.1 = f32[64,48]{1,0} fusion(%x, %y), kind=kOutput, calls=%fused_computation.1
+  %fusion.2 = f32[64]{0} fusion(%x), kind=kLoop, calls=%fused_computation.2
+}
+"""
+        fl = hlo_fusion_flops(hlo)
+        assert classify_op("fusion.1", flops_map=fl) == "matmul"
+        assert classify_op("fusion.2", flops_map=fl) == "vector"
+
+
+class TestPhaseReport:
+    """Synthetic Chrome-trace fixtures drive the classifier and the
+    exposed-collective overlap math deterministically on CPU (ISSUE 9
+    satellite: no live capture needed)."""
+
+    def _write(self, path, events):
+        import gzip, json
+        with gzip.open(path, "wt") as f:
+            json.dump({"traceEvents": events}, f)
+
+    def _fixture(self, tmp_path, events):
+        d = tmp_path / "plugins" / "profile" / "run1"
+        d.mkdir(parents=True)
+        self._write(str(d / "host.trace.json.gz"),
+                    [{"ph": "M", "name": "process_name", "pid": 1,
+                      "args": {"name": "/device:TPU:0"}}] + events)
+        return str(tmp_path)
+
+    def test_phases_and_exposed_overlap(self, tmp_path):
+        # collective lane: [0, 1000); compute lanes cover [0, 600) and
+        # [700, 800) -> exposed = 1000 - 600 - 100 = 300us = 0.3ms
+        logdir = self._fixture(tmp_path, [
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 0.0, "dur": 1000.0,
+             "name": "all-reduce.1"},
+            {"ph": "X", "pid": 1, "tid": 2, "ts": 0.0, "dur": 600.0,
+             "name": "fusion.3"},
+            {"ph": "X", "pid": 1, "tid": 2, "ts": 700.0, "dur": 100.0,
+             "name": "dot.2"},
+            {"ph": "X", "pid": 1, "tid": 3, "ts": 1200.0, "dur": 50.0,
+             "name": "copy.9"},
+        ])
+        from apex_tpu.profiling import phase_report
+
+        rep = phase_report(logdir)
+        assert rep.phase_ms["collective"] == pytest.approx(1.0)
+        assert rep.phase_ms["vector"] == pytest.approx(0.6)
+        assert rep.phase_ms["matmul"] == pytest.approx(0.1)
+        assert rep.phase_ms["copy"] == pytest.approx(0.05)
+        assert rep.collective_ms == pytest.approx(1.0)
+        assert rep.exposed_collective_ms == pytest.approx(0.3)
+        assert rep.total_ms == pytest.approx(1.75)
+        assert rep.span_ms == pytest.approx(1.25)  # [0, 1250)
+        assert rep.n_ops == 4
+        assert rep.top_ops[0].name == "all-reduce.1"
+
+    def test_copy_does_not_hide_collectives(self, tmp_path):
+        """Only compute (matmul/vector/custom) hides a collective: a
+        concurrent copy/infeed leaves it exposed — D2D traffic is not
+        the overlap ROADMAP item 3 is allowed to claim."""
+        logdir = self._fixture(tmp_path, [
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 0.0, "dur": 400.0,
+             "name": "all-gather.1"},
+            {"ph": "X", "pid": 1, "tid": 2, "ts": 0.0, "dur": 400.0,
+             "name": "copy.1"},
+        ])
+        from apex_tpu.profiling import phase_report
+
+        rep = phase_report(logdir)
+        assert rep.exposed_collective_ms == pytest.approx(0.4)
+
+    def test_overlapping_collectives_union_not_sum(self, tmp_path):
+        """Two concurrent collectives on different lanes count their
+        union toward exposure, never double."""
+        logdir = self._fixture(tmp_path, [
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 0.0, "dur": 300.0,
+             "name": "all-reduce.1"},
+            {"ph": "X", "pid": 1, "tid": 2, "ts": 100.0, "dur": 300.0,
+             "name": "reduce-scatter.2"},
+        ])
+        from apex_tpu.profiling import phase_report
+
+        rep = phase_report(logdir)
+        assert rep.collective_ms == pytest.approx(0.4)   # [0, 400)
+        assert rep.exposed_collective_ms == pytest.approx(0.4)
+        # per-phase sum still counts both ops' durations
+        assert rep.phase_ms["collective"] == pytest.approx(0.6)
+
+    def test_fully_hidden_collective_reads_zero(self, tmp_path):
+        logdir = self._fixture(tmp_path, [
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 100.0, "dur": 200.0,
+             "name": "all-reduce.1"},
+            {"ph": "X", "pid": 1, "tid": 2, "ts": 0.0, "dur": 500.0,
+             "name": "fusion.1"},
+        ])
+        from apex_tpu.profiling import phase_report
+
+        rep = phase_report(logdir)
+        assert rep.exposed_collective_ms == 0.0
+        assert rep.collective_ms == pytest.approx(0.2)
+
+    def test_hlo_text_reclassifies_fusions(self, tmp_path):
+        logdir = self._fixture(tmp_path, [
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 0.0, "dur": 100.0,
+             "name": "fusion.1"},
+        ])
+        from apex_tpu.profiling import phase_report
+
+        hlo = """
+%fused_computation.1 (p0: f32[8,8], p1: f32[8,8]) -> f32[8,8] {
+  %p0 = f32[8,8]{1,0} parameter(0)
+  %p1 = f32[8,8]{1,0} parameter(1)
+  ROOT %d = f32[8,8]{1,0} dot(%p0, %p1), lhs_contracting_dims={1}
+}
+ENTRY %main {
+  %x = f32[8,8]{1,0} parameter(0)
+  %fusion.1 = f32[8,8]{1,0} fusion(%x, %x), kind=kOutput, calls=%fused_computation.1
+}
+"""
+        assert phase_report(logdir).phase_ms == {"vector": 0.1}
+        rep = phase_report(logdir, hlo_text=hlo)
+        assert rep.phase_ms == {"matmul": 0.1}
+
+    def test_to_payload_is_json_ready(self, tmp_path):
+        import json as _json
+
+        logdir = self._fixture(tmp_path, [
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 0.0, "dur": 10.0,
+             "name": "all-reduce.1"},
+        ])
+        from apex_tpu.profiling import phase_report
+
+        p = phase_report(logdir).to_payload()
+        _json.dumps(p)
+        assert p["exposed_collective_ms"] == pytest.approx(0.01)
+        assert p["top_ops"][0]["name"] == "all-reduce.1"
+
+
+class TestFlopOverrides:
+    """ISSUE 9 satellite: per-op analytic flop overrides make the
+    documented 5x-under-report on Pallas custom calls fixable."""
+
+    def test_flash_attention_flops_values(self):
+        from apex_tpu.profiling import flash_attention_flops
+
+        # 2 matmuls x 2*s*s*d each per (b, h) row
+        assert flash_attention_flops(128, 1024, 64) == pytest.approx(
+            2 * 2 * 128 * 1024 * 1024 * 64)
+        assert flash_attention_flops(128, 1024, 64, causal=True) \
+            == pytest.approx(2 * 128 * 1024 * 1024 * 64)
+        assert flash_attention_flops(1, 128, 64, backward=True) \
+            == pytest.approx(2.5 * 4 * 128 * 128 * 64)
+
+    def test_join_roofline_override_resolves_custom_call(self):
+        from apex_tpu.profiling import OpTime, flash_attention_flops
+        from apex_tpu.profiling.trace_report import join_roofline
+
+        hlo = ('ENTRY %main {\n'
+               '  %custom-call.3 = f32[128,1024,64]{2,1,0} '
+               'custom-call(%q, %k, %v), '
+               'custom_call_target="tpu_custom_call", '
+               'metadata={op_name="jit(step)/flash_fwd" '
+               'source_file="attention.py"}\n'
+               '}\n')
+        ops = [OpTime(name="custom-call.3", total_ms=2.0, calls=1,
+                      frac_of_device=1.0)]
+        fl = flash_attention_flops(128, 1024, 64)
+        # without the override: the documented blind spot (flops 0)
+        row0 = join_roofline(ops, hlo)[0]
+        assert row0["est_gflops"] == 0.0
+        row = join_roofline(ops, hlo, roof_tflops=180.0,
+                            flop_overrides={"flash_fwd": fl})[0]
+        assert row["flops_src"] == "override"
+        assert row["est_gflops"] == pytest.approx(fl / 1e9, abs=0.01)
+        assert row["achieved_tflops"] == pytest.approx(
+            fl / 2e-3 / 1e12, abs=0.1)
+
+    def test_override_never_clobbers_parsed_flops(self):
+        """An op the HLO parser already attributed keeps its parsed
+        flops even when an override pattern matches."""
+        from apex_tpu.profiling import OpTime
+        from apex_tpu.profiling.trace_report import join_roofline
+
+        hlo = """
+%fused_computation.1 (p0: f32[640,320], p1: f32[320,480]) -> f32[640,480] {
+  %p0 = f32[640,320]{1,0} parameter(0)
+  %p1 = f32[320,480]{1,0} parameter(1)
+  ROOT %d = f32[640,480]{1,0} dot(%p0, %p1), lhs_contracting_dims={1}
+}
+ENTRY %main (x: f32[640,320], y: f32[320,480]) -> f32[640,480] {
+  %x = f32[640,320]{1,0} parameter(0)
+  %y = f32[320,480]{1,0} parameter(1)
+  %fusion.1 = f32[640,480]{1,0} fusion(%x, %y), kind=kOutput, calls=%fused_computation.1, metadata={op_name="jit(f)/dot_general"}
+}
+"""
+        ops = [OpTime(name="fusion.1", total_ms=1.0, calls=1,
+                      frac_of_device=1.0)]
+        row = join_roofline(ops, hlo, flop_overrides={"fusion.1": 1e15})[0]
+        assert row["est_gflops"] == pytest.approx(
+            2 * 640 * 320 * 480 / 1e9, abs=0.01)
+        assert "flops_src" not in row
+
+    def test_cost_report_adds_override_flops(self):
+        """cost_report(flop_overrides=...) patches XLA's cost-analysis
+        blind spot: matched custom calls add analytic flops, recorded
+        separately in override_flops."""
+        from apex_tpu import profiling
+
+        class FakeCompiled:
+            def cost_analysis(self):
+                return {"flops": 100.0, "bytes accessed": 10.0}
+
+            def memory_analysis(self):
+                return None
+
+            def as_text(self):
+                return ('ENTRY %main {\n'
+                        '  %custom-call.1 = f32[8]{0} custom-call(%x), '
+                        'custom_call_target="tpu_custom_call", '
+                        'metadata={op_name="jit(f)/flash_fwd"}\n'
+                        '  %custom-call.2 = f32[8]{0} custom-call(%y), '
+                        'custom_call_target="tpu_custom_call", '
+                        'metadata={op_name="jit(f)/flash_fwd"}\n'
+                        '}\n')
+
+        rep = profiling.cost_report_from_compiled(
+            FakeCompiled(), flop_overrides={"flash_fwd": 1e9})
+        assert rep.override_flops == pytest.approx(2e9)  # both calls
+        assert rep.flops == pytest.approx(100.0 + 2e9)
+        # no overrides: unchanged behavior
+        rep0 = profiling.cost_report_from_compiled(FakeCompiled())
+        assert rep0.flops == 100.0 and rep0.override_flops == 0.0
+
+
 class TestRooflineJoin:
     """hlo_fusion_flops / join_roofline: the pyprof measured-time x
     derived-flops join (VERDICT r3 missing #2)."""
