@@ -24,9 +24,11 @@ from apex_tpu.serving.engine import (  # noqa: F401
     ServingEngine,
     SimClock,
     poisson_trace,
+    set_fault_hook,
 )
 from apex_tpu.serving.kv_cache import (  # noqa: F401
     PagedKVCache,
+    PagePoolCorruption,
     PagePoolExhausted,
 )
 from apex_tpu.serving.model import (  # noqa: F401
@@ -39,6 +41,7 @@ from apex_tpu.serving.scheduler import (  # noqa: F401
     RUNNING,
     WAITING,
     ContinuousBatchingScheduler,
+    QueueFullError,
     Request,
 )
 
@@ -46,12 +49,15 @@ __all__ = [
     "ServingEngine",
     "SimClock",
     "poisson_trace",
+    "set_fault_hook",
     "PagedKVCache",
+    "PagePoolCorruption",
     "PagePoolExhausted",
     "PagedDecoder",
     "ServingModelConfig",
     "init_params",
     "ContinuousBatchingScheduler",
+    "QueueFullError",
     "Request",
     "WAITING",
     "RUNNING",

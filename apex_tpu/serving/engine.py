@@ -37,28 +37,67 @@ available for throughput-over-isolation deployments; the engine does
 not use it (docs/serving.md, "Prefill isolation").
 
 Telemetry: every lifecycle edge lands on the PR 4 bus as one of the
-three serving event types — ``request_admit``, ``request_retire``
-(with per-request TTFT/TPOT), ``decode_step`` (batch width, tokens,
-page-pool occupancy) — so ``python -m apex_tpu.telemetry summarize``
-renders a serving line and the bench's stream is schema-validated by
-the existing ``validate`` CLI.
+serving event types — ``request_admit``, ``request_retire`` (with
+per-request TTFT/TPOT and, when the request carried a deadline, a
+``deadline_hit`` bool), ``decode_step`` (batch width, tokens,
+page-pool occupancy), plus the ISSUE 10 resilience set
+(``request_reject``, ``request_timeout``, ``serving_recovery``) — so
+``python -m apex_tpu.telemetry summarize`` renders a serving line and
+the bench's stream is schema-validated by the existing ``validate``
+CLI.
+
+**Failure semantics (ISSUE 10).** The engine degrades instead of
+falling over: per-request deadlines shed/time out work that can no
+longer meet its SLO, a bounded submit queue rejects overload loudly,
+:meth:`ServingEngine.snapshot`/:meth:`~ServingEngine.restore` capture
+the HOST-side serving state (queue order + per-request tokens — KV
+pages deliberately excluded, they are rebuildable by deterministic
+re-prefill), and a :class:`~apex_tpu.resilience.chaos.DeviceLossError`
+or :class:`~apex_tpu.serving.kv_cache.PagePoolCorruption` raised
+mid-decode triggers :meth:`~ServingEngine.recover` — fresh pool,
+live requests back to the front of the queue, token streams bitwise
+identical to an uninterrupted run.  See docs/serving.md "Failure
+semantics".
 """
 
 from __future__ import annotations
 
 import time
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from apex_tpu.serving.kv_cache import PagedKVCache
+from apex_tpu.serving.kv_cache import (PagedKVCache, PagePoolCorruption)
 from apex_tpu.serving.model import (PagedDecoder, ServingModelConfig,
                                     init_params)
-from apex_tpu.serving.scheduler import (WAITING,
+from apex_tpu.serving.scheduler import (FINISHED, WAITING,
                                         ContinuousBatchingScheduler,
-                                        Request)
+                                        QueueFullError, Request)
+
+# -- chaos hook (ISSUE 10) ---------------------------------------------------
+# The serving twin of checkpoint.set_fault_hook / data.set_read_hook:
+# the chaos tier installs an injector here to raise DeviceLossError /
+# sleep / corrupt a page at a named engine event ("decode" before each
+# decode launch, "prefill" before each prefill launch).  Production
+# never sets it; the slot costs one None-check per step.
+
+_FAULT_HOOK: Optional[Callable[[str, int], None]] = None
+
+
+def set_fault_hook(hook: Optional[Callable[[str, int], None]]):
+    """Install (or clear) the serving fault hook; returns the previous
+    hook so context-manager injectors can chain/restore."""
+    global _FAULT_HOOK
+    prev = _FAULT_HOOK
+    _FAULT_HOOK = hook
+    return prev
+
+
+def _fault_point(event: str, info: int) -> None:
+    if _FAULT_HOOK is not None:
+        _FAULT_HOOK(event, info)
 
 
 class SimClock:
@@ -81,11 +120,20 @@ class SimClock:
 def poisson_trace(seed: int, n_requests: int, *, rate: float,
                   prompt_len: Tuple[int, int], max_new: Tuple[int, int],
                   vocab_size: int,
-                  eos_id: Optional[int] = None) -> List[Request]:
+                  eos_id: Optional[int] = None,
+                  deadline_s: Optional[Tuple[float, float]] = None,
+                  rid_base: int = 0) -> List[Request]:
     """Seeded Poisson arrival trace: exponential inter-arrival gaps at
     ``rate`` requests/s, uniform prompt lengths and generation budgets.
     Deterministic in ``seed`` — the serving bench's workload and the
-    scheduler determinism test share this generator."""
+    scheduler determinism test share this generator.
+
+    ``deadline_s`` — optional (lo, hi) uniform completion-deadline
+    range (seconds after arrival; the overload/SLO arcs use this).
+    The draw happens only when requested, so deadline-free traces are
+    bit-identical to the pre-ISSUE-10 generator.  ``rid_base`` offsets
+    request ids so a second trace can be served on the same engine
+    (rids are unique per engine lifetime)."""
     rng = np.random.RandomState(seed)
     t = 0.0
     out: List[Request] = []
@@ -93,11 +141,13 @@ def poisson_trace(seed: int, n_requests: int, *, rate: float,
         t += float(rng.exponential(1.0 / rate))
         plen = int(rng.randint(prompt_len[0], prompt_len[1] + 1))
         out.append(Request(
-            rid=rid,
+            rid=rid_base + rid,
             prompt=[int(x) for x in rng.randint(0, vocab_size, plen)],
             max_new_tokens=int(rng.randint(max_new[0], max_new[1] + 1)),
             eos_id=eos_id,
             arrival_t=t,
+            deadline_s=(None if deadline_s is None else
+                        float(rng.uniform(deadline_s[0], deadline_s[1]))),
         ))
     return out
 
@@ -112,7 +162,22 @@ class ServingEngine:
     optional :class:`~apex_tpu.telemetry.TelemetryBus`; ``clock`` an
     optional ``() -> float`` (tests pass :class:`SimClock` for
     deterministic timing fields — timing never feeds scheduling
-    decisions, only metrics).
+    decisions, only metrics and, when requests carry deadlines, the
+    deadline policy).
+
+    Resilience knobs (ISSUE 10 — docs/serving.md "Failure semantics"):
+    ``max_queue`` bounds the submit queue (overflow → ``rejected``
+    terminal state + ``request_reject`` event, never unbounded growth);
+    ``preempt_cap`` is the anti-livelock aging cap on evict-newest
+    preemption; ``shed_min_service_s`` is the SLO floor used to shed
+    queued requests BEFORE their deadline expires; ``watchdog`` is an
+    optional :class:`~apex_tpu.resilience.elastic.Watchdog` armed
+    around every engine step (a wedged decode escalates instead of
+    hanging the trace); ``validate_pages`` turns on per-page CRC
+    read-back validation in the pool; ``recover_on_fault`` lets
+    :meth:`serve`/:meth:`run` absorb a mid-decode
+    ``DeviceLossError``/``PagePoolCorruption`` via :meth:`recover`
+    (at most ``max_recoveries`` times, then the fault re-raises).
     """
 
     def __init__(self, cfg: ServingModelConfig, params=None, *,
@@ -122,7 +187,14 @@ class ServingEngine:
                  prefill_budget: Optional[int] = None,
                  telemetry=None,
                  clock: Optional[Callable[[], float]] = None,
-                 seed: int = 0):
+                 seed: int = 0,
+                 max_queue: Optional[int] = None,
+                 preempt_cap: Optional[int] = 4,
+                 shed_min_service_s: float = 0.0,
+                 watchdog=None,
+                 validate_pages: bool = False,
+                 recover_on_fault: bool = True,
+                 max_recoveries: int = 3):
         self.cfg = cfg
         self.params = params if params is not None else init_params(cfg, seed)
         self.prefill_budget = (cfg.max_position if prefill_budget is None
@@ -134,15 +206,22 @@ class ServingEngine:
             page_size=page_size, num_heads=cfg.num_heads,
             head_dim=cfg.head_dim,
             max_pages_per_request=max_pages_per_request,
-            dtype=cfg.dtype)
+            dtype=cfg.dtype, crc_pages=validate_pages)
         self.sched = ContinuousBatchingScheduler(
             self.cache, max_batch=max_batch,
             prefill_budget=self.prefill_budget,
-            max_position=cfg.max_position)
+            max_position=cfg.max_position,
+            max_queue=max_queue, preempt_cap=preempt_cap)
         self.decoder = PagedDecoder(cfg)
         self.max_batch = max_batch
         self.telemetry = telemetry
         self.clock = clock if clock is not None else time.monotonic
+        self.shed_min_service_s = float(shed_min_service_s)
+        self.watchdog = watchdog
+        self.recover_on_fault = recover_on_fault
+        self.max_recoveries = int(max_recoveries)
+        self.recoveries = 0
+        self.rejected: List[Request] = []
         self._next_rid = 0
         self.steps = 0
         self.decode_steps = 0
@@ -176,9 +255,15 @@ class ServingEngine:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
                eos_id: Optional[int] = None,
-               arrival_t: Optional[float] = None) -> Request:
+               arrival_t: Optional[float] = None,
+               deadline_s: Optional[float] = None) -> Request:
         """Create and queue a request; returns its :class:`Request`
-        handle (tokens accumulate on ``.generated``)."""
+        handle (tokens accumulate on ``.generated``).  ``deadline_s``
+        is the completion SLO in seconds after arrival.  A full
+        bounded queue does NOT raise: the returned request is already
+        terminal (``finish_reason == "rejected"``) and a
+        ``request_reject`` event is emitted — the caller checks the
+        handle, the trace keeps flowing."""
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if not prompt:
@@ -186,16 +271,32 @@ class ServingEngine:
         req = Request(rid=self._next_rid, prompt=list(prompt),
                       max_new_tokens=max_new_tokens, eos_id=eos_id,
                       arrival_t=(self.clock() if arrival_t is None
-                                 else arrival_t))
+                                 else arrival_t),
+                      deadline_s=deadline_s)
         self._next_rid += 1
-        self.sched.submit(req)
-        return req
+        return self._try_submit(req)
 
     def submit_request(self, req: Request) -> Request:
         """Queue a pre-built request (trace replay); rids must be
-        unique per engine."""
+        unique per engine.  Same reject semantics as :meth:`submit`."""
         self._next_rid = max(self._next_rid, req.rid + 1)
-        self.sched.submit(req)
+        return self._try_submit(req)
+
+    def _try_submit(self, req: Request) -> Request:
+        """Queue ``req`` or reject it explicitly.  Never-servable
+        requests still raise ``ValueError`` (caller bug); a full
+        bounded queue is an OVERLOAD signal: the request finishes as
+        ``rejected`` with a ``request_reject`` event, and the engine
+        keeps serving what it already accepted."""
+        try:
+            self.sched.submit(req)
+        except QueueFullError:
+            req.state = FINISHED
+            req.finish_t = self.clock()
+            req.finish_reason = "rejected"
+            self.rejected.append(req)
+            self._emit("request_reject", rid=req.rid, reason="queue_full",
+                       queue_depth=len(self.sched.waiting))
         return req
 
     # -- device steps ------------------------------------------------------
@@ -228,6 +329,18 @@ class ServingEngine:
         ctx = req.context
         C = len(ctx)
         ps = self.cache.page_size
+        # reserve-at-admit invariant (ISSUE 10 satellite): admission
+        # allocated this request's context pages; prefill must never
+        # find the reservation gone (the admit-then-exhaust window the
+        # regression test closes) — a violation here is a scheduler
+        # bug, not a capacity event
+        need = self.cache.pages_needed(C)
+        if len(req.pages) < need:
+            raise RuntimeError(
+                f"request {req.rid}: prefill found {len(req.pages)} "
+                f"reserved pages, context needs {need} — pages must be "
+                "reserved at admission")
+        _fault_point("prefill", req.rid)
         tokens = np.zeros((1, S), np.int32)
         tokens[0, :C] = ctx
         seg = np.zeros((1, S), np.int32)
@@ -252,14 +365,21 @@ class ServingEngine:
     def _decode_batch(self, rows: List[Request]) -> None:
         """One decode step for ``rows`` (≤ max_batch), idle-padded to
         the fixed batch width."""
+        _fault_point("decode", self.decode_steps)
+        # opt-in read-back validation: the pages this step is about to
+        # attend over must still match their recorded CRCs
+        self.cache.verify_pages([req.pages for req in rows])
         b = self.max_batch
+        ps = self.cache.page_size
         tokens = np.zeros((b,), np.int32)
         positions = np.zeros((b,), np.int32)
         kv_len = np.ones((b,), np.int32)
+        written: List[int] = []   # the page each row's new K/V lands in
         for i, req in enumerate(rows):
             tokens[i] = req.generated[-1]
             positions[i] = req.seq_len - 1
             kv_len[i] = req.seq_len
+            written.append(req.pages[(req.seq_len - 1) // ps])
         page_table = self.cache.page_table(
             [req.pages for req in rows], rows=b)
         next_tok, k_pool, v_pool = self._decode_fn(
@@ -267,6 +387,7 @@ class ServingEngine:
             jnp.asarray(tokens), jnp.asarray(positions), page_table,
             jnp.asarray(kv_len))
         self.cache.k, self.cache.v = k_pool, v_pool
+        self.cache.refresh_page_crcs(written)
         next_tok = np.asarray(next_tok)
         for i, req in enumerate(rows):
             req.kv_len = req.seq_len
@@ -291,14 +412,45 @@ class ServingEngine:
                     ev["tpot_ms"] = round(
                         (req.finish_t - req.first_token_t) / (n - 1) * 1e3,
                         3)
+            if req.deadline_t is not None and req.finish_t is not None:
+                # a real bool, present only when a deadline existed —
+                # optionality explicit, never a sentinel
+                ev["deadline_hit"] = bool(req.finish_t <= req.deadline_t)
             self._emit("request_retire", **ev)
         return done
 
+    def _expire(self, now: float) -> bool:
+        """Deadline enforcement for this step boundary: shed queued
+        requests that can no longer meet their SLO, retire in-flight
+        expirations with a ``timeout`` status (pages freed
+        immediately).  Each drop is a ``request_timeout`` event saying
+        WHERE the request was when its deadline died."""
+        shed, timed_out = self.sched.expire_deadlines(
+            now, min_service_s=self.shed_min_service_s)
+        for req in shed:
+            self._emit("request_timeout", rid=req.rid, where="queued",
+                       overshoot_ms=round((now - req.deadline_t) * 1e3, 3))
+        for req in timed_out:
+            self._emit("request_timeout", rid=req.rid, where="running",
+                       overshoot_ms=round((now - req.deadline_t) * 1e3, 3))
+        return bool(shed or timed_out)
+
     def step(self) -> bool:
-        """One engine iteration: retire → admit+prefill → retire →
-        grow/preempt → decode.  Returns True if any work was done."""
+        """One engine iteration: expire deadlines → retire →
+        admit+prefill → retire → grow/preempt → decode.  Returns True
+        if any work was done.  With a ``watchdog``, the whole step
+        (prefill + decode device work included) runs under an armed
+        deadline, so a wedged device step escalates instead of
+        hanging the trace."""
+        if self.watchdog is None:
+            return self._step_body()
+        with self.watchdog.step(self.steps):
+            return self._step_body()
+
+    def _step_body(self) -> bool:
         now = self.clock()
-        progress = bool(self._retire(now))
+        progress = self._expire(now)
+        progress = bool(self._retire(now)) or progress
         admitted = self.sched.admit()
         for req in admitted:
             req.admit_t = now
@@ -336,7 +488,170 @@ class ServingEngine:
             self.clock.advance()
         return progress
 
+    # -- crash recovery (ISSUE 10) -----------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Serializable capture of the HOST-side serving state: queue
+        order (running first, in admission order, then waiting) plus
+        each live request's token state and counters.
+
+        KV pages are DELIBERATELY excluded: the PR 8 preemption
+        contract makes re-prefill from the kept tokens regenerate a
+        request's KV deterministically, so the pool never needs to be
+        checkpointed — the snapshot is a few KB of tokens, not
+        gigabytes of HBM.  ``restore`` re-prefills live requests
+        through that existing path.  JSON-serializable by construction
+        (pinned in the round-trip test)."""
+        def rec(req: Request, was_running: bool) -> Dict[str, Any]:
+            return {
+                "rid": req.rid,
+                "prompt": list(req.prompt),
+                "max_new_tokens": req.max_new_tokens,
+                "eos_id": req.eos_id,
+                "arrival_t": req.arrival_t,
+                "deadline_s": req.deadline_s,
+                "generated": list(req.generated),
+                "preemptions": req.preemptions,
+                "admit_t": req.admit_t,
+                "first_token_t": req.first_token_t,
+                "was_running": was_running,
+            }
+
+        return {
+            "format": 1,
+            "next_rid": self._next_rid,
+            "steps": self.steps,
+            "decode_steps": self.decode_steps,
+            "requests": ([rec(r, True) for r in self.sched.running]
+                         + [rec(r, False) for r in self.sched.waiting]),
+        }
+
+    def restore(self, snap: Dict[str, Any]) -> List[Request]:
+        """Rebuild serving state from a :meth:`snapshot` into THIS
+        (idle, freshly constructed) engine.  Every snapshotted request
+        — running or waiting at capture — enters the waiting queue in
+        snapshot order with no pages; previously-running requests are
+        re-admitted first and re-prefilled through the deterministic
+        preemption path, so the continued token streams are bitwise
+        the uninterrupted run's.  Returns the restored request
+        handles."""
+        if self.sched.running or self.sched.waiting:
+            raise RuntimeError(
+                "restore into a busy engine — serving state would be "
+                "interleaved; restore only into a fresh engine")
+        if snap.get("format") != 1:
+            raise ValueError(
+                f"unknown serving snapshot format {snap.get('format')!r}")
+        restored: List[Request] = []
+        for r in snap["requests"]:
+            req = Request(
+                rid=int(r["rid"]), prompt=list(r["prompt"]),
+                max_new_tokens=int(r["max_new_tokens"]),
+                eos_id=r["eos_id"], arrival_t=float(r["arrival_t"]),
+                deadline_s=r["deadline_s"])
+            req.generated = list(r["generated"])
+            req.preemptions = int(r["preemptions"])
+            req.admit_t = r["admit_t"]
+            req.first_token_t = r["first_token_t"]
+            if req.done:
+                # captured between its last decode and its retirement:
+                # already complete — re-admitting would overshoot
+                # max_new_tokens by re-prefilling + sampling again
+                self._finish_restored(req)
+            else:
+                req.state = WAITING
+                self.sched.waiting.append(req)
+            restored.append(req)
+        self._next_rid = max(self._next_rid, int(snap["next_rid"]))
+        self.steps = int(snap["steps"])
+        self.decode_steps = int(snap["decode_steps"])
+        return restored
+
+    def _finish_restored(self, req: Request) -> None:
+        """Retire a request that was already done when the crash hit
+        (its last decode ran, retirement hadn't).  The retire event
+        carries no finish timing — the crashed run took those
+        measurements down with it; optional means absent."""
+        req.state = FINISHED
+        req.finish_reason = (
+            "eos" if req.eos_id is not None and req.generated
+            and req.generated[-1] == req.eos_id else "length")
+        self.sched.finished.append(req)
+        self._emit("request_retire", rid=req.rid, reason=req.finish_reason,
+                   new_tokens=len(req.generated),
+                   preemptions=req.preemptions)
+
+    def recover(self, cause: str) -> None:
+        """In-process crash recovery after a device loss / pool
+        corruption: discard the device pool (its content is garbage or
+        gone), rebuild a fresh one, and put every live request back on
+        the waiting queue — running requests first, in admission
+        order, tokens kept.  Re-admission re-prefills them through the
+        deterministic path, so recovery is output-invisible (the
+        acceptance pin: per-request token streams bitwise identical to
+        an uninterrupted control).  The caller's :class:`Request`
+        handles stay live — this is the in-process twin of
+        :meth:`snapshot`/:meth:`restore`."""
+        running = list(self.sched.running)
+        waiting = list(self.sched.waiting)
+        old = self.cache
+        self.cache = PagedKVCache(
+            num_layers=self.cfg.num_layers, num_pages=old.num_pages,
+            page_size=old.page_size, num_heads=self.cfg.num_heads,
+            head_dim=self.cfg.head_dim,
+            max_pages_per_request=old.max_pages_per_request,
+            dtype=self.cfg.dtype, crc_pages=old.crc_pages)
+        sched = ContinuousBatchingScheduler(
+            self.cache, max_batch=self.max_batch,
+            prefill_budget=self.prefill_budget,
+            max_position=self.cfg.max_position,
+            max_queue=self.sched.max_queue,
+            preempt_cap=self.sched.preempt_cap)
+        sched.finished = self.sched.finished   # history survives
+        self.sched = sched
+        for req in running:
+            req.pages = []
+            req.kv_len = 0
+            if req.done:
+                # complete-but-unretired at the fault boundary: finish
+                # it here rather than re-prefill past max_new_tokens
+                self._finish_restored(req)
+            else:
+                req.state = WAITING
+                sched.waiting.append(req)
+        sched.waiting.extend(waiting)
+        # re-place the params on the (rebuilt) device; the two jitted
+        # executables are shape-keyed and survive as-is
+        self.params = jax.device_put(self.params)
+        self.recoveries += 1
+        self._emit("serving_recovery", cause=cause, pool_rebuilt=True,
+                   running_restored=len(running),
+                   waiting_restored=len(waiting))
+
+    def _handle_fault(self, exc: BaseException) -> None:
+        """Absorb a recoverable mid-decode fault via :meth:`recover`,
+        or re-raise when recovery is disabled/exhausted."""
+        if not self.recover_on_fault or self.recoveries >= self.max_recoveries:
+            raise exc
+        device_ids = getattr(exc, "device_ids", None)
+        if device_ids is not None:
+            self._emit("device_loss", device_ids=list(device_ids))
+        cause = ("device_loss" if device_ids is not None
+                 else "page_corruption")
+        self.recover(cause=cause)
+
     # -- drivers -----------------------------------------------------------
+
+    def _guarded_step(self) -> None:
+        """One step with the ISSUE 10 recovery net: a mid-decode
+        device loss or CRC-caught page corruption triggers rebuild +
+        restore + continue instead of killing the trace."""
+        from apex_tpu.resilience.chaos import DeviceLossError
+
+        try:
+            self.step()
+        except (DeviceLossError, PagePoolCorruption) as e:
+            self._handle_fault(e)
 
     def run(self, max_steps: int = 100_000) -> List[Request]:
         """Step until every queued request has finished; returns the
@@ -344,7 +659,7 @@ class ServingEngine:
         for _ in range(max_steps):
             if self.sched.idle:
                 break
-            self.step()
+            self._guarded_step()
         else:
             raise RuntimeError(f"engine did not drain in {max_steps} steps")
         self._retire(self.clock())
@@ -381,7 +696,7 @@ class ServingEngine:
                 self.submit_request(pending[i])
                 i += 1
             if not self.sched.idle:
-                self.step()
+                self._guarded_step()
             elif i < len(pending):
                 gap = pending[i].arrival_t - now
                 if isinstance(self.clock, SimClock):

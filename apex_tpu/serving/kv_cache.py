@@ -31,7 +31,8 @@ carries).
 from __future__ import annotations
 
 import bisect
-from typing import Dict, List, Optional, Sequence
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -49,6 +50,14 @@ class PagePoolExhausted(RuntimeError):
     is an ordinary, recoverable scheduling event."""
 
 
+class PagePoolCorruption(RuntimeError):
+    """A pool page's content no longer matches its recorded CRC32 —
+    an HBM bit flip / DMA fault stand-in (ISSUE 10).  Recoverable by
+    construction: page content is always rebuildable from host-side
+    tokens via deterministic re-prefill, so the engine treats this
+    like a device loss (rebuild pool + restore) rather than an abort."""
+
+
 class PagedKVCache:
     """Fixed-size paged KV pool shared by all in-flight requests.
 
@@ -60,7 +69,7 @@ class PagedKVCache:
     def __init__(self, *, num_layers: int, num_pages: int,
                  page_size: int, num_heads: int, head_dim: int,
                  max_pages_per_request: int,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, crc_pages: bool = False):
         if num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is the "
                              "reserved scratch page)")
@@ -85,6 +94,14 @@ class PagedKVCache:
         # sorted free list, lowest-first allocation: deterministic
         self._free: List[int] = list(range(1, num_pages))
         self._owner: Dict[int, int] = {}
+        # opt-in per-page CRC validation (ISSUE 10): every host-visible
+        # write records a crc32 of the page's K and V bytes;
+        # verify_pages re-reads the device content and raises
+        # PagePoolCorruption on mismatch.  Costs a device->host pull
+        # per touched page per step — a chaos/debug knob, off by
+        # default (docs/serving.md "Failure semantics").
+        self.crc_pages = bool(crc_pages)
+        self._crc: Dict[int, Tuple[int, int]] = {}
 
     # -- accounting ------------------------------------------------------
 
@@ -121,6 +138,7 @@ class PagedKVCache:
             if p == 0 or p in self._free:
                 raise ValueError(f"double free / scratch free: page {p}")
             self._owner.pop(p, None)
+            self._crc.pop(p, None)
             bisect.insort(self._free, p)
 
     def owner_of(self, page: int) -> Optional[int]:
@@ -151,10 +169,47 @@ class PagedKVCache:
         ``k_new``/``v_new``: ``[num_layers, T, num_heads, head_dim]``;
         token t lands in ``(pages[t], offsets[t])``.  Padding positions
         point at the scratch page 0."""
+        touched = ({int(p) for p in np.asarray(pages).ravel()} - {0}
+                   if self.crc_pages else ())
         pages = jnp.asarray(pages, jnp.int32)
         offsets = jnp.asarray(offsets, jnp.int32)
         self.k, self.v = self._scatter(
             self.k, self.v, k_new, v_new, pages, offsets)
+        if self.crc_pages:
+            self.refresh_page_crcs(touched)
+
+    # -- per-page CRC validation (ISSUE 10, opt-in) ----------------------
+
+    def _page_digest(self, page: int) -> Tuple[int, int]:
+        """crc32 of page ``page``'s K and V bytes across all layers."""
+        k = np.ascontiguousarray(np.asarray(self.k[:, page]))
+        v = np.ascontiguousarray(np.asarray(self.v[:, page]))
+        return (zlib.crc32(k.tobytes()), zlib.crc32(v.tobytes()))
+
+    def refresh_page_crcs(self, pages: Sequence[int]) -> None:
+        """Re-record CRCs after a host-visible write (prefill scatter /
+        the decode step's per-row append).  No-op unless ``crc_pages``."""
+        if not self.crc_pages:
+            return
+        for p in sorted({int(p) for p in pages} - {0}):
+            self._crc[p] = self._page_digest(p)
+
+    def verify_pages(self, page_lists: Sequence[Sequence[int]]) -> None:
+        """Read-back validation: recompute each live page's digest and
+        compare against the recorded CRC; raises
+        :class:`PagePoolCorruption` naming the damaged page.  Pages
+        with no recorded CRC (never written through a CRC-tracking
+        path) are skipped — absence of a record is not corruption."""
+        if not self.crc_pages:
+            return
+        for p in sorted({int(p) for lst in page_lists for p in lst} - {0}):
+            want = self._crc.get(p)
+            if want is None:
+                continue
+            if self._page_digest(p) != want:
+                raise PagePoolCorruption(
+                    f"page {p} failed CRC read-back "
+                    f"(owner rid {self._owner.get(p)})")
 
     # -- defrag ----------------------------------------------------------
 
@@ -184,6 +239,9 @@ class PagedKVCache:
         self.v = self.v[:, src_j]
         self._owner = {mapping[p]: o for p, o in self._owner.items()
                        if p in mapping}
+        # content moves verbatim with the ids, so digests remap too
+        self._crc = {mapping[p]: c for p, c in self._crc.items()
+                     if p in mapping}
         self._free = list(range(len(live) + 1, self.num_pages))
         for pages in page_lists:
             pages[:] = [mapping[p] for p in pages]

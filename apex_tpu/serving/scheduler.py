@@ -40,6 +40,28 @@ so a seeded arrival trace replays bit-identically):
   docs/serving.md "Preemption").
 * **retirement**: EOS or ``max_new_tokens`` reached → pages freed (and
   immediately reusable), terminal state recorded.
+
+Resilience policy (ISSUE 10 — docs/serving.md "Failure semantics"):
+
+* **deadlines**: a request may carry ``deadline_s`` (seconds after
+  arrival by which it must FINISH).  :meth:`expire_deadlines` sheds
+  queued requests that can no longer meet it (``now + min_service_s``
+  already past the deadline — the SLO-aware part: shedding *before*
+  expiry refuses work that would only burn pool pages to miss anyway)
+  and retires in-flight expirations with a ``timeout`` status and
+  immediate page free.
+* **bounded queue**: ``max_queue`` caps the waiting queue; ``submit``
+  raises :class:`QueueFullError` instead of growing without bound
+  under overload (the engine converts it into an explicit
+  ``request_reject`` event — load is refused loudly, never absorbed
+  into an hours-deep queue every entry of which will time out).
+* **anti-livelock aging**: evict-newest preemption skips requests that
+  have already been preempted ``preempt_cap`` times — a long request
+  under sustained short-request pressure is hit at most ``preempt_cap``
+  times and then becomes senior to fresh admissions, so it provably
+  completes (pinned by the livelock regression test).  When EVERY
+  running request is at the cap the plain newest is evicted anyway
+  (progress must never deadlock on the aging rule).
 """
 
 from __future__ import annotations
@@ -55,6 +77,13 @@ RUNNING = "running"
 FINISHED = "finished"
 
 
+class QueueFullError(RuntimeError):
+    """The bounded submit queue is full — the overload reject signal,
+    not an error in the request itself (a retry later may succeed).
+    The engine converts it into a ``request_reject`` telemetry event
+    and a ``rejected`` terminal state."""
+
+
 @dataclasses.dataclass
 class Request:
     """One serving request and its runtime state."""
@@ -64,6 +93,9 @@ class Request:
     max_new_tokens: int
     eos_id: Optional[int] = None
     arrival_t: float = 0.0
+    # completion deadline, seconds after arrival (None = no SLO).
+    # Stored relative so serve()'s arrival rebase moves it too.
+    deadline_s: Optional[float] = None
     # runtime
     state: str = WAITING
     generated: List[int] = dataclasses.field(default_factory=list)
@@ -74,6 +106,13 @@ class Request:
     first_token_t: Optional[float] = None
     finish_t: Optional[float] = None
     finish_reason: Optional[str] = None
+
+    @property
+    def deadline_t(self) -> Optional[float]:
+        """Absolute deadline on the engine clock (None = no SLO)."""
+        if self.deadline_s is None:
+            return None
+        return self.arrival_t + self.deadline_s
 
     @property
     def context(self) -> List[int]:
@@ -97,11 +136,17 @@ class ContinuousBatchingScheduler:
     """Admission/growth/preemption/retirement over a shared page pool."""
 
     def __init__(self, cache: PagedKVCache, *, max_batch: int,
-                 prefill_budget: int, max_position: int):
+                 prefill_budget: int, max_position: int,
+                 max_queue: Optional[int] = None,
+                 preempt_cap: Optional[int] = 4):
         self.cache = cache
         self.max_batch = max_batch
         self.prefill_budget = prefill_budget
         self.max_position = max_position
+        # overload policy (ISSUE 10): bounded submit queue + aging cap
+        # on evict-newest preemption (None disables either)
+        self.max_queue = max_queue
+        self.preempt_cap = preempt_cap
         self.waiting: Deque[Request] = deque()
         self.running: List[Request] = []   # admission order
         self.finished: List[Request] = []
@@ -131,6 +176,14 @@ class ContinuousBatchingScheduler:
             raise ValueError(
                 f"request {req.rid}: prompt+max_new {worst} exceeds "
                 f"prefill budget {self.prefill_budget}")
+        if self.max_queue is not None and len(self.waiting) >= self.max_queue:
+            # overload: refuse loudly rather than queue work that will
+            # only time out.  Only NEW submissions are bounded —
+            # preemption requeues bypass submit() by design (an evicted
+            # request must always be able to come back)
+            raise QueueFullError(
+                f"request {req.rid}: submit queue full "
+                f"({len(self.waiting)}/{self.max_queue})")
         self.waiting.append(req)
 
     # -- admission -------------------------------------------------------
@@ -174,10 +227,25 @@ class ContinuousBatchingScheduler:
     def preempt_one(self) -> Optional[Request]:
         """Evict the most-recently-admitted running request: free its
         pages, keep its tokens, requeue it at the FRONT of the waiting
-        queue.  Returns the victim (or None if nothing runs)."""
+        queue.  Returns the victim (or None if nothing runs).
+
+        Anti-livelock aging (ISSUE 10): a request already preempted
+        ``preempt_cap`` times is skipped — the victim is the newest
+        request still UNDER the cap, so sustained pressure cannot hit
+        the same request forever.  If every running request is capped
+        the plain newest is evicted anyway: the aging rule bounds
+        repeat victimization, it must never deadlock progress."""
         if not self.running:
             return None
-        victim = self.running.pop()
+        victim = None
+        if self.preempt_cap is not None:
+            for req in reversed(self.running):
+                if req.preemptions < self.preempt_cap:
+                    victim = req
+                    break
+        if victim is None:
+            victim = self.running[-1]
+        self.running.remove(victim)
         self.cache.free(victim.pages)
         victim.pages = []
         victim.kv_len = 0
@@ -212,6 +280,55 @@ class ContinuousBatchingScheduler:
                     assert victim is not None  # self.running non-empty
                     evicted.append(victim)
         return evicted
+
+    # -- deadlines -------------------------------------------------------
+
+    def expire_deadlines(self, now: float, *, min_service_s: float = 0.0
+                         ) -> tuple:
+        """Enforce per-request deadlines; returns ``(shed, timed_out)``.
+
+        *Shed* — queued requests that can no longer meet their deadline
+        (``now + min_service_s`` at or past it; ``min_service_s`` is
+        the caller's floor estimate of remaining service time, 0.0 =
+        shed only once expired).  They finish with reason ``"shed"``
+        without ever taking pool pages.
+
+        *Timed out* — RUNNING requests whose deadline has passed:
+        removed from the batch with reason ``"timeout"`` and their
+        pages freed immediately (reusable by the very next admission —
+        the timeout-storm no-leak test pins this).
+        """
+        shed: List[Request] = []
+        timed_out: List[Request] = []
+        for req in list(self.waiting):
+            dt = req.deadline_t
+            if dt is not None and now + min_service_s >= dt:
+                self.waiting.remove(req)
+                req.state = FINISHED
+                req.finish_t = now
+                req.finish_reason = "shed"
+                self.finished.append(req)
+                shed.append(req)
+        for req in list(self.running):
+            dt = req.deadline_t
+            if req.done:
+                # its last token was generated before the deadline
+                # died — the request is COMPLETE, just not yet swept
+                # by retire_finished (the engine retires right after
+                # expiring); timing it out here would misreport a full
+                # token stream as a timeout
+                continue
+            if dt is not None and now >= dt:
+                self.running.remove(req)
+                self.cache.free(req.pages)
+                req.pages = []
+                req.kv_len = 0
+                req.state = FINISHED
+                req.finish_t = now
+                req.finish_reason = "timeout"
+                self.finished.append(req)
+                timed_out.append(req)
+        return shed, timed_out
 
     # -- retirement ------------------------------------------------------
 

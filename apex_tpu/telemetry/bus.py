@@ -60,6 +60,15 @@ EVENT_TYPES = frozenset({
                        # per-request TTFT/TPOT latency record
     "decode_step",     # serving: one continuous-batching decode step
                        # (batch width, tokens, page-pool occupancy)
+    "request_reject",  # serving: bounded submit queue refused a request
+                       # under overload (explicit shed, never silent
+                       # unbounded queue growth) — ISSUE 10
+    "request_timeout",  # serving: a request's deadline died — shed from
+                        # the queue or retired mid-flight with its pages
+                        # freed immediately — ISSUE 10
+    "serving_recovery",  # serving: engine rebuilt the KV pool and
+                         # restored live requests after a device loss /
+                         # page corruption mid-decode — ISSUE 10
     "profile",         # ProfileSampler window: per-phase device ms,
                        # exposed-collective ms, top-k ops (ISSUE 9)
     "memory",          # ProfileSampler HBM sample: live/peak bytes from

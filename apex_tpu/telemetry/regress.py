@@ -33,17 +33,23 @@ __all__ = ["load_bench_keys", "key_direction", "compare_bench",
            "format_regress", "GATED_LOWER", "GATED_HIGHER"]
 
 #: Lower-is-better key patterns (regex, searched): latency, wait,
-#: skip/stall counts, memory peaks, exposed communication.
+#: skip/stall counts, memory peaks, exposed communication.  ``_p99``
+#: (ISSUE 10) covers tail-latency keys that don't end in the
+#: percentile (``serving_tpot_p99_overload``).
 GATED_LOWER = (
     r"_ms$", r"_ms_p\d+$", r"_ms_per_step$", r"tpot", r"ttft",
     r"_wait_ms", r"_hbm_peak_gb$", r"peak_hbm_gb$", r"_hbm_gb$",
-    r"exposed_collective_ms$", r"_phase_collective_ms$",
+    r"exposed_collective_ms$", r"_phase_collective_ms$", r"_p99",
 )
 
-#: Higher-is-better key patterns: throughput, efficiency, rooflines.
+#: Higher-is-better key patterns: throughput, efficiency, rooflines,
+#: SLO attainment (``*_hit_rate``, ISSUE 10).  Note ``*_shed_rate`` is
+#: DELIBERATELY unmatched: the right shed rate depends on the offered
+#: load, so the gate reports it but must not guess a direction.
 GATED_HIGHER = (
     r"_per_sec$", r"_tflops$", r"_mfu", r"goodput$", r"_speedup",
     r"_gb_s$", r"frac_of_roof$", r"frac_of_dot_floor$", r"_min_ratio$",
+    r"_hit_rate$",
 )
 
 

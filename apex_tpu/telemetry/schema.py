@@ -64,6 +64,18 @@ PAYLOAD_REQUIRED: Dict[str, Dict[str, tuple]] = {
                        "new_tokens": (int,), "preemptions": (int,)},
     "decode_step": {"batch": (int,), "new_tokens": (int,),
                     "pool_used": (int,), "pool_pages": (int,)},
+    # serving resilience (ISSUE 10): overload rejects, deadline deaths
+    # (where = "queued" shed / "running" timeout), and crash recovery.
+    # pool_rebuilt is a REAL bool (bool-not-int discipline); the
+    # optional deadline_hit on request_retire is likewise a bool,
+    # present only when the request carried a deadline
+    "request_reject": {"rid": (int,), "reason": (str,),
+                       "queue_depth": (int,)},
+    "request_timeout": {"rid": (int,), "where": (str,),
+                        "overshoot_ms": NUMBER},
+    "serving_recovery": {"cause": (str,), "pool_rebuilt": (bool,),
+                         "running_restored": (int,),
+                         "waiting_restored": (int,)},
     # in-run attribution (ISSUE 9): the ProfileSampler's window result.
     # phase_ms maps phase -> device ms; exposed_collective_ms is the
     # overlap-analysis headline; overhead_ms is the sampler's own host

@@ -38,6 +38,8 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     tpot_ms: List[float] = []
     ttft_ms: List[float] = []
     pool_occ: List[float] = []
+    deadline_hits = deadline_total = 0
+    queue_sheds = run_timeouts = 0
     phase_ms: Dict[str, List[float]] = {}
     exposed_ms: List[float] = []
     profile_overhead_ms = 0.0
@@ -59,6 +61,18 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                 tpot_ms.append(float(ev["tpot_ms"]))
             if ev.get("ttft_ms") is not None:
                 ttft_ms.append(float(ev["ttft_ms"]))
+            if ev.get("deadline_hit") is not None:
+                deadline_total += 1
+                deadline_hits += 1 if ev["deadline_hit"] else 0
+        elif ev.get("type") == "request_timeout":
+            # a timed-out request HAD a deadline by definition and
+            # missed it — it counts in the hit-rate denominator even
+            # though it never produced a retire record
+            deadline_total += 1
+            if ev.get("where") == "queued":
+                queue_sheds += 1
+            else:
+                run_timeouts += 1
         elif ev.get("type") == "decode_step":
             if ev.get("pool_pages"):
                 pool_occ.append(ev.get("pool_used", 0)
@@ -96,7 +110,8 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "data_stalls": counts.get("data_stall", 0),
         "records_quarantined": counts.get("data_quarantine", 0),
     }
-    if counts.get("request_retire") or counts.get("decode_step"):
+    if counts.get("request_retire") or counts.get("decode_step") \
+            or counts.get("request_timeout") or counts.get("request_reject"):
         # serving summary (ISSUE 8): the one-screen view of a serving
         # stream is latency percentiles + pool pressure, not step time
         st, sf = sorted(tpot_ms), sorted(ttft_ms)
@@ -110,6 +125,18 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                                    if sf else None)
         out["serving_pool_peak"] = (round(max(pool_occ), 4)
                                     if pool_occ else None)
+        # overload/deadline health (ISSUE 10): sheds = explicit load
+        # refusal (bounded-queue rejects + queued deadline sheds);
+        # timeouts = in-flight deadline deaths; deadline hit rate =
+        # hits over every deadline-carrying request seen (completions
+        # AND deadline deaths — rejects are excluded because a reject
+        # event does not say whether a deadline existed)
+        out["serving_rejects"] = counts.get("request_reject", 0)
+        out["serving_sheds"] = out["serving_rejects"] + queue_sheds
+        out["serving_timeouts"] = run_timeouts
+        out["serving_deadline_hit_rate"] = (
+            round(deadline_hits / deadline_total, 4)
+            if deadline_total else None)
     if counts.get("profile"):
         # phase attribution (ISSUE 9): mean per-phase device ms over the
         # run's sampled windows — the answer to "where do a step's
@@ -185,6 +212,12 @@ def format_summary(s: Dict[str, Any]) -> str:
             parts.append(f"ttft p50 {_ms(s['serving_ttft_p50'])}")
         if s.get("serving_pool_peak") is not None:
             parts.append(f"pool peak {_pct(s['serving_pool_peak'])}")
+        if s.get("serving_sheds") or s.get("serving_timeouts"):
+            parts.append(f"shed {s.get('serving_sheds', 0)} "
+                         f"timeout {s.get('serving_timeouts', 0)}")
+        if s.get("serving_deadline_hit_rate") is not None:
+            parts.append(
+                f"deadline hit {_pct(s['serving_deadline_hit_rate'])}")
         lines.append("  ".join(parts))
     if s.get("profile_samples"):
         parts = ["phases      " + "  ".join(
@@ -224,6 +257,8 @@ _DIFF_ROWS = (
     ("steps_per_sec", "steps/s", "{:.3f}"),
     ("data_stalls", "data stalls", "{:d}"),
     ("serving_tpot_p50", "tpot p50 (ms)", "{:.2f}"),
+    # overload health (ISSUE 10): did the change move the SLO story?
+    ("serving_deadline_hit_rate", "deadline hit", "{:.3f}"),
     # phase-attribution rows (ISSUE 9): did the change move exposed
     # communication or the memory high-water mark?
     ("exposed_collective_ms", "exposed (ms)", "{:.2f}"),

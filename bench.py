@@ -1286,8 +1286,18 @@ def bench_serving():
     ``serving_tpot_p50/p95`` (time-per-output-token),
     ``serving_ttft_p50`` (admission-to-first-token, queueing included)
     and ``serving_pool_peak`` (page-pool occupancy high-water mark).
+
+    Overload segment (ISSUE 10): a second trace at 2x the arrival
+    rate with per-request deadlines (SLO derived from the measured
+    segment's own TTFT/TPOT medians) and a bounded submit queue —
+    ``serving_deadline_hit_rate`` (SLO attainment over ALL offered
+    requests, sheds counted as misses), ``serving_shed_rate``
+    (explicit rejects+sheds over offered; reported-not-gated — the
+    right shed rate depends on the offered load), and
+    ``serving_tpot_p99_overload`` (served tail under pressure).
     """
     from apex_tpu import telemetry as tel
+    from apex_tpu.telemetry.summarize import percentile
     from apex_tpu.serving import (ServingEngine, ServingModelConfig,
                                   init_params, poisson_trace)
 
@@ -1353,9 +1363,11 @@ def bench_serving():
         from apex_tpu.telemetry import ProfileSampler, device_memory_payload
 
         samp = ProfileSampler(bus, window=1)
+        # rid_base keeps the stream's rids unique across the run's
+        # three traces (measured / mini / overload)
         mini = poisson_trace(1, max(2, max_batch // 2), rate=rate,
                              prompt_len=prompt_len, max_new=max_new,
-                             vocab_size=V)
+                             vocab_size=V, rid_base=50_000)
         rep = samp.capture(lambda: eng.serve(mini), step=None)
         if rep is None:
             profile_keys["serving_profile_error"] = (
@@ -1379,13 +1391,66 @@ def bench_serving():
                 mem_stats["peak_bytes"] / 1e9, 2)
     except Exception as e:
         profile_keys["serving_profile_error"] = repr(e)[:160]
+
+    # headline percentiles come from the measured trace only (the
+    # mini-trace and the overload segment below append to the stream
+    # after this snapshot)
+    measured = list(mem.events[:n_measured])
+    s = tel.summarize_events(measured)
+
+    # ---- overload flagship (ISSUE 10): 2x arrival rate, per-request
+    # deadlines, bounded submit queue.  The questions this answers:
+    # under offered load the engine cannot sustain, does it shed
+    # explicitly (serving_shed_rate), what SLO attainment survives
+    # (serving_deadline_hit_rate), and what does the served tail look
+    # like (serving_tpot_p99_overload)?  The stream stays on the same
+    # bus, so the whole arc — rejects, timeouts, retires — schema-
+    # validates through the validate CLI below.
+    n_over = int(os.environ.get("BENCH_SERVING_OVERLOAD_REQS",
+                                str(2 * n_req)))
+    eng.sched.max_queue = 2 * max_batch  # host-side policy knob only:
+    # no device shape changes, so the two compiled executables serve
+    # the overload segment as-is
+    over_trace = poisson_trace(2, n_over, rate=2.0 * rate,
+                               prompt_len=prompt_len, max_new=max_new,
+                               vocab_size=V, rid_base=100_000)
+    # per-request SLO derived from the measured segment's latencies:
+    # first token within ~2x the observed TTFT median, then each new
+    # token at ~3x the observed TPOT median — tight enough that 2x
+    # overload misses some, loose enough that served requests can hit
+    tpot_ref = s.get("serving_tpot_p50") or 50.0
+    ttft_ref = s.get("serving_ttft_p50") or 200.0
+    for r in over_trace:
+        r.deadline_s = (2.0 * ttft_ref
+                        + 3.0 * r.max_new_tokens * tpot_ref) / 1e3
+    t0 = time.perf_counter()
+    eng.serve(over_trace)
+    over_wall_s = time.perf_counter() - t0
+    completed = [r for r in over_trace
+                 if r.finish_reason in ("eos", "length")]
+    hits = [r for r in completed
+            if r.finish_t is not None and r.finish_t <= r.deadline_t]
+    dropped = [r for r in over_trace
+               if r.finish_reason in ("rejected", "shed")]
+    timeouts = [r for r in over_trace if r.finish_reason == "timeout"]
+    over_tpot = sorted(
+        (r.finish_t - r.first_token_t) / (len(r.generated) - 1) * 1e3
+        for r in completed
+        if r.first_token_t is not None and len(r.generated) > 1)
+    overload_keys = {
+        "serving_deadline_hit_rate": round(len(hits) / n_over, 4),
+        "serving_shed_rate": round(len(dropped) / n_over, 4),
+        "serving_tpot_p99_overload": (
+            round(percentile(over_tpot, 0.99), 3)
+            if over_tpot else None),
+        "serving_overload_requests": n_over,
+        "serving_overload_completed": len(completed),
+        "serving_overload_timeouts": len(timeouts),
+        "serving_overload_wall_s": round(over_wall_s, 2),
+    }
     bus.close()
 
     n_events = tel.validate_jsonl(stream)  # the acceptance contract
-    # the mini-trace's decode/admit/retire events would skew the
-    # headline latency percentiles: summarize only the measured trace
-    measured = mem.events[:n_measured]
-    s = tel.summarize_events(measured)
     decode_tokens = sum(ev.get("new_tokens", 0) for ev in measured
                         if ev.get("type") == "decode_step")
     decode_s = sum(ev.get("step_ms", 0.0) for ev in measured
@@ -1407,6 +1472,7 @@ def bench_serving():
         "serving_stream_events": n_events,
         "serving_telemetry_file": os.path.basename(stream),
         **profile_keys,
+        **overload_keys,
         "serving_config": {
             "layers": L, "hidden": H, "heads": NH, "vocab": V,
             "dtype": "bf16", "page_size": page_size,

@@ -311,6 +311,12 @@ def test_regress_direction_rules():
     assert key_direction("gpt1p3b_exposed_collective_ms") == "lower"
     assert key_direction("gpt1p3b_hbm_peak_gb") == "lower"
     assert key_direction("resnet50_phase_collective_ms") == "lower"
+    # serving overload keys (ISSUE 10): SLO attainment up, tail
+    # latency down, shed rate REPORTED but never gated (its right
+    # value depends on the offered load — a gate must not guess)
+    assert key_direction("serving_deadline_hit_rate") == "higher"
+    assert key_direction("serving_tpot_p99_overload") == "lower"
+    assert key_direction("serving_shed_rate") is None
     # config echoes and counters are NOT gated
     assert key_direction("gpt1p3b_batch") is None
     assert key_direction("bench_schema") is None
@@ -419,6 +425,30 @@ def test_regress_self_test_on_committed_records(capsys):
     keys = {r["key"] for r in gated}
     assert "gpt350m_tokens_per_sec" in keys
     assert "resnet50_amp_o2_fusedlamb_images_per_sec" in keys
+
+
+def test_regress_serving_keys_mandatory_on_committed_pair(capsys):
+    """ISSUE 10 satellite: ``serving_deadline_hit_rate`` is MANDATORY
+    (via --keys) over the committed serving BENCH pair — if a future
+    change drops the overload segment's headline key, the gate fails
+    instead of silently comparing nothing."""
+    a = os.path.join(REPO, "BENCH_r10_serving.json")
+    b = os.path.join(REPO, "BENCH_r10b_serving.json")
+    rc = tele_cli(["regress", a, b, "--max-regress", "75", "--json",
+                   "--keys", "serving_deadline_hit_rate,"
+                             "serving_tpot_p99_overload,"
+                             "serving_shed_rate"])
+    rec = json.loads(capsys.readouterr().out)
+    assert rc == 0, rec["failures"]
+    by_key = {r["key"]: r for r in rec["rows"]}
+    assert by_key["serving_deadline_hit_rate"]["direction"] == "higher"
+    assert by_key["serving_tpot_p99_overload"]["direction"] == "lower"
+    assert by_key["serving_shed_rate"]["gated"] is False
+    # the committed records really carry non-degenerate overload data
+    assert 0.0 < by_key["serving_deadline_hit_rate"]["a"] <= 1.0
+    # ...and a vanished mandatory key is a failure, not a skip
+    assert tele_cli(["regress", a, b, "--max-regress", "75",
+                     "--keys", "serving_deadline_hit_rate,gone_key"]) == 1
 
 
 def test_regress_refuses_unparsed_driver_capture(capsys):

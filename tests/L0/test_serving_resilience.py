@@ -1,0 +1,598 @@
+"""Serving resilience tier (ISSUE 10): request deadlines + overload
+shedding, anti-livelock aging, engine crash recovery with
+deterministic KV rebuild, per-page CRC validation, and the serving
+chaos injectors.
+
+THE acceptance pin lives here: a ``DeviceLossError`` raised MID-DECODE
+(live requests holding pool pages) triggers rebuild + restore +
+continue, and every request's token stream is bitwise identical to an
+uninterrupted control — the PR 8 deterministic re-prefill contract is
+what makes the KV pool checkpoint-free.
+"""
+
+import json
+
+import pytest
+
+import jax.numpy as jnp
+
+from apex_tpu.resilience import chaos
+from apex_tpu.resilience.chaos import DeviceLossError
+from apex_tpu.resilience.elastic import Watchdog, WatchdogTimeout
+from apex_tpu.serving import (FINISHED, ContinuousBatchingScheduler,
+                              PagedKVCache, QueueFullError, Request,
+                              ServingEngine, ServingModelConfig, SimClock,
+                              init_params, poisson_trace)
+
+pytestmark = pytest.mark.serving
+
+CFG = ServingModelConfig(vocab_size=64, hidden_size=32, num_heads=4,
+                         num_layers=2, max_position=96)
+
+
+@pytest.fixture(scope="module")
+def serving_params():
+    return init_params(CFG, seed=0)
+
+
+def _engine(params, **kw):
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("prefill_budget", CFG.max_position)
+    kw.setdefault("clock", SimClock())
+    return ServingEngine(CFG, params, **kw)
+
+
+def _trace(**kw):
+    kw.setdefault("rate", 2.0)
+    kw.setdefault("prompt_len", (4, 10))
+    kw.setdefault("max_new", (3, 8))
+    kw.setdefault("vocab_size", CFG.vocab_size)
+    return poisson_trace(3, 6, **kw)
+
+
+@pytest.fixture(scope="module")
+def control_tokens(serving_params):
+    """Uninterrupted control streams for the shared trace shape."""
+    tr = _trace()
+    _engine(serving_params).serve(tr)
+    return {r.rid: list(r.generated) for r in tr}
+
+
+# ---------------------------------------------------------------------------
+# Deadlines: queue shedding, in-flight timeout, SLO-aware early shed
+# ---------------------------------------------------------------------------
+
+
+def _sched(num_pages=9, page_size=8, max_batch=4, prefill_budget=64,
+           max_position=64, max_pages_per_request=8, **kw):
+    cache = PagedKVCache(num_layers=1, num_pages=num_pages,
+                         page_size=page_size, num_heads=1, head_dim=4,
+                         max_pages_per_request=max_pages_per_request)
+    return ContinuousBatchingScheduler(
+        cache, max_batch=max_batch, prefill_budget=prefill_budget,
+        max_position=max_position, **kw), cache
+
+
+class TestDeadlines:
+    def test_expired_queued_request_is_shed(self):
+        sched, cache = _sched()
+        r = Request(rid=0, prompt=[1] * 4, max_new_tokens=4,
+                    arrival_t=0.0, deadline_s=2.0)
+        sched.submit(r)
+        shed, touts = sched.expire_deadlines(1.0)
+        assert not shed and not touts          # still meetable
+        shed, touts = sched.expire_deadlines(2.0)
+        assert shed == [r] and not touts
+        assert r.state == FINISHED and r.finish_reason == "shed"
+        assert not sched.waiting and r in sched.finished
+
+    def test_slo_shed_before_expiry(self):
+        # the SLO-aware part: with a min-service floor the scheduler
+        # refuses work that COULD only miss, before the deadline dies
+        sched, _ = _sched()
+        r = Request(rid=0, prompt=[1] * 4, max_new_tokens=4,
+                    arrival_t=0.0, deadline_s=5.0)
+        sched.submit(r)
+        shed, _ = sched.expire_deadlines(1.0, min_service_s=3.0)
+        assert not shed                        # 1 + 3 < 5: still viable
+        shed, _ = sched.expire_deadlines(2.0, min_service_s=3.0)
+        assert shed == [r]                     # 2 + 3 >= 5: hopeless
+
+    def test_running_timeout_frees_pages_immediately(self):
+        sched, cache = _sched()
+        r = Request(rid=0, prompt=[1] * 12, max_new_tokens=8,
+                    arrival_t=0.0, deadline_s=3.0)
+        sched.submit(r)
+        sched.admit()
+        assert r.state == "running" and cache.pages_used > 0
+        shed, touts = sched.expire_deadlines(3.0)
+        assert touts == [r] and not shed
+        assert r.finish_reason == "timeout" and r.pages == []
+        assert cache.pages_used == 0
+        # the freed pages are reusable by the very next admission
+        r2 = Request(rid=1, prompt=[1] * 12, max_new_tokens=2)
+        sched.submit(r2)
+        assert sched.admit() == [r2]
+
+    def test_deadline_free_requests_never_expire(self):
+        sched, _ = _sched()
+        sched.submit(Request(rid=0, prompt=[1] * 4, max_new_tokens=4))
+        assert sched.expire_deadlines(1e9) == ([], [])
+
+    def test_done_but_unretired_request_is_not_timed_out(self):
+        # review regression: a request whose LAST token was generated
+        # before its deadline died is complete, merely not yet swept by
+        # retire_finished (the engine expires before retiring) — it
+        # must retire normally, never be misreported as a timeout
+        sched, _ = _sched()
+        r = Request(rid=0, prompt=[1] * 4, max_new_tokens=2,
+                    arrival_t=0.0, deadline_s=1.0)
+        sched.submit(r)
+        sched.admit()
+        r.generated.extend([5, 6])             # done, awaiting sweep
+        shed, touts = sched.expire_deadlines(10.0)   # deadline long dead
+        assert not shed and not touts
+        assert sched.retire_finished(10.0) == [r]
+        assert r.finish_reason == "length"
+
+
+class TestBoundedQueue:
+    def test_scheduler_raises_queue_full(self):
+        sched, _ = _sched(max_queue=2)
+        sched.submit(Request(rid=0, prompt=[1] * 4, max_new_tokens=2))
+        sched.submit(Request(rid=1, prompt=[1] * 4, max_new_tokens=2))
+        with pytest.raises(QueueFullError):
+            sched.submit(Request(rid=2, prompt=[1] * 4, max_new_tokens=2))
+
+    def test_preemption_requeue_bypasses_the_bound(self):
+        # an evicted request must ALWAYS be able to come back, even
+        # when the queue is at its bound — only NEW submissions are
+        # refused
+        sched, _ = _sched(max_queue=1)
+        r0 = Request(rid=0, prompt=[1] * 8, max_new_tokens=2)
+        sched.submit(r0)
+        sched.admit()
+        sched.submit(Request(rid=1, prompt=[1] * 4, max_new_tokens=2))
+        victim = sched.preempt_one()
+        assert victim is r0 and sched.waiting[0] is r0
+        assert len(sched.waiting) == 2         # over the bound, by design
+
+    def test_engine_rejects_explicitly_with_event(self, serving_params):
+        from apex_tpu import telemetry as tel
+
+        mem = tel.MemorySink()
+        bus = tel.TelemetryBus(run_id="reject", sinks=[mem])
+        eng = _engine(serving_params, max_queue=2, telemetry=bus)
+        reqs = [eng.submit([1, 2, 3], 2) for _ in range(4)]
+        rejected = [r for r in reqs if r.finish_reason == "rejected"]
+        assert len(rejected) == 2 and eng.rejected == rejected
+        assert all(r.state == FINISHED for r in rejected)
+        ev = [e for e in mem.events if e["type"] == "request_reject"]
+        assert len(ev) == 2
+        for e in ev:
+            tel.validate_event(e)
+            assert e["reason"] == "queue_full" and e["queue_depth"] == 2
+        # the accepted half still serves to completion
+        eng.run()
+        assert all(len(r.generated) == 2 for r in reqs[:2])
+
+
+# ---------------------------------------------------------------------------
+# Anti-livelock aging
+# ---------------------------------------------------------------------------
+
+
+class TestAging:
+    def _two_running(self, cap):
+        sched, cache = _sched(num_pages=14, page_size=4,
+                              max_pages_per_request=12, preempt_cap=cap)
+        a = Request(rid=0, prompt=[1] * 4, max_new_tokens=30)
+        b = Request(rid=1, prompt=[1] * 4, max_new_tokens=30)
+        sched.submit(a)
+        sched.submit(b)
+        for req in sched.admit():
+            req.kv_len = len(req.context)
+            req.generated.append(0)
+        return sched, cache, a, b
+
+    def test_cap_redirects_eviction_after_repeat_hits(self):
+        """THE livelock regression pin: evict-newest may hit the same
+        request at most ``preempt_cap`` times; after that the aging
+        rule makes it senior and the victim is the newest request
+        still under the cap."""
+        sched, cache, a, b = self._two_running(cap=2)
+        for round_ in range(3):
+            # pool-dry pressure: a dummy owner holds every free page,
+            # then the newest running request crosses a page boundary
+            dummy = cache.allocate(cache.pages_free, owner=-1)
+            victim_pool = list(sched.running)
+            grow = victim_pool[-1]
+            grow.generated.extend([0] * 4)     # cross a page boundary
+            evicted = sched.ensure_decode_capacity()
+            assert len(evicted) >= 1
+            cache.free([p for p in dummy if cache.owner_of(p) == -1])
+            for req in sched.admit():
+                req.kv_len = len(req.context)
+            if round_ < 2:
+                assert evicted[0] is b, (round_, evicted)
+            else:
+                # b is capped (2 preemptions): a — the OLDER request —
+                # takes the hit instead
+                assert any(r is a for r in evicted), (
+                    round_, [r.rid for r in evicted], b.preemptions)
+        assert b.preemptions == 2
+
+    def test_uncapped_keeps_hitting_the_newest(self):
+        sched, cache, a, b = self._two_running(cap=None)
+        for _ in range(3):
+            dummy = cache.allocate(cache.pages_free, owner=-1)
+            sched.running[-1].generated.extend([0] * 4)
+            evicted = sched.ensure_decode_capacity()
+            assert evicted and evicted[0] is b
+            cache.free([p for p in dummy if cache.owner_of(p) == -1])
+            for req in sched.admit():
+                req.kv_len = len(req.context)
+        assert b.preemptions == 3 and a.preemptions == 0
+
+    def test_long_request_completes_under_sustained_pressure(self):
+        """Property: a long request keeps completing while short
+        requests arrive EVERY step — sustained pressure must never
+        starve it past the cap."""
+        sched, cache = _sched(num_pages=9, page_size=4,
+                              max_pages_per_request=8, max_batch=3,
+                              preempt_cap=2)
+        long_req = Request(rid=0, prompt=[1] * 4, max_new_tokens=20)
+        sched.submit(long_req)
+        next_rid = 1
+        for t in range(200):
+            if next_rid <= 40:
+                sched.submit(Request(rid=next_rid, prompt=[1] * 8,
+                                     max_new_tokens=2))
+                next_rid += 1
+            for req in sched.admit():
+                req.kv_len = len(req.context)
+                req.generated.append(0)
+            sched.retire_finished(float(t))
+            if sched.running:
+                sched.ensure_decode_capacity()
+                for req in sched.running:
+                    req.kv_len = req.seq_len
+                    req.generated.append(0)
+            sched.retire_finished(float(t))
+            if sched.idle and next_rid > 40:
+                break
+        assert long_req.state == FINISHED, (
+            long_req.state, long_req.preemptions)
+        assert long_req.preemptions <= 2
+        assert len(long_req.generated) == 20
+        assert cache.pages_used == 0
+
+
+# ---------------------------------------------------------------------------
+# Reserve-at-admit (ISSUE 10 satellite: the admit-then-exhaust window)
+# ---------------------------------------------------------------------------
+
+
+class TestReserveAtAdmit:
+    def test_admit_then_exhaust_leaves_reservation_intact(self):
+        # the regression: pages are reserved AT ADMIT, so exhausting
+        # the pool between admission and prefill cannot steal the
+        # admitted request's pages
+        sched, cache = _sched(num_pages=9, page_size=8)
+        r = Request(rid=0, prompt=[1] * 20, max_new_tokens=4)
+        sched.submit(r)
+        assert sched.admit() == [r]
+        reserved = list(r.pages)
+        assert len(reserved) == cache.pages_needed(20)
+        cache.allocate(cache.pages_free, owner=99)   # the exhaust window
+        assert cache.pages_free == 0
+        # the reservation survives: same pages, same owner
+        assert r.pages == reserved
+        assert all(cache.owner_of(p) == r.rid for p in reserved)
+
+    def test_prefill_asserts_reservation(self, serving_params):
+        # defence in depth: a prefill that somehow finds its
+        # reservation gone is a scheduler BUG and must raise loudly,
+        # not scatter K/V into unowned pages
+        eng = _engine(serving_params)
+        req = eng.submit([1, 2, 3, 4], 2)
+        admitted = eng.sched.admit()
+        assert admitted == [req]
+        stolen = req.pages
+        req.pages = []
+        with pytest.raises(RuntimeError, match="reserved"):
+            eng._prefill_request(req)
+        req.pages = stolen  # restore for clean teardown
+
+
+# ---------------------------------------------------------------------------
+# Crash recovery: THE acceptance pin + snapshot/restore round trip
+# ---------------------------------------------------------------------------
+
+
+class TestCrashRecovery:
+    def test_device_loss_mid_decode_recovers_bitwise(
+            self, serving_params, control_tokens):
+        """Acceptance criterion: device loss mid-decode → rebuild +
+        restore → per-request token streams bitwise identical to the
+        uninterrupted control."""
+        from apex_tpu import telemetry as tel
+
+        mem = tel.MemorySink()
+        bus = tel.TelemetryBus(run_id="loss", sinks=[mem])
+        tr = _trace()
+        with chaos.ServingDeviceLoss(at_step=3, device_ids=[0],
+                                     telemetry=bus) as dl:
+            eng = _engine(serving_params, telemetry=bus)
+            eng.serve(tr)
+        assert dl.fired and eng.recoveries == 1
+        got = {r.rid: list(r.generated) for r in tr}
+        assert got == control_tokens           # bitwise, token-for-token
+        types = [e["type"] for e in mem.events]
+        assert "serving_recovery" in types and "device_loss" in types
+        rec = next(e for e in mem.events if e["type"] == "serving_recovery")
+        assert rec["pool_rebuilt"] is True and rec["cause"] == "device_loss"
+        assert rec["running_restored"] >= 1    # mid-decode: batch was live
+        for e in mem.events:
+            tel.validate_event(e)
+
+    def test_corrupt_page_caught_and_recovered_bitwise(
+            self, serving_params, control_tokens):
+        from apex_tpu import telemetry as tel
+
+        mem = tel.MemorySink()
+        bus = tel.TelemetryBus(run_id="crc", sinks=[mem])
+        eng = _engine(serving_params, telemetry=bus, validate_pages=True)
+        tr = _trace()
+        with chaos.CorruptLivePage(eng.cache, at_step=2,
+                                   telemetry=bus) as cp:
+            eng.serve(tr)
+        assert cp.corrupted_page is not None and eng.recoveries == 1
+        got = {r.rid: list(r.generated) for r in tr}
+        assert got == control_tokens
+        rec = next(e for e in mem.events if e["type"] == "serving_recovery")
+        assert rec["cause"] == "page_corruption"
+
+    def test_corruption_without_crc_validation_goes_unnoticed(
+            self, serving_params):
+        # the control for the CRC feature: the same byte flip with
+        # validation OFF raises nothing (the damage silently perturbs
+        # attention) — which is exactly why the knob exists
+        eng = _engine(serving_params)
+        tr = _trace()
+        with chaos.CorruptLivePage(eng.cache, at_step=2):
+            eng.serve(tr)
+        assert eng.recoveries == 0
+
+    def test_recovery_budget_exhausted_reraises(self, serving_params):
+        with chaos.ServingDeviceLoss(at_step=2):
+            eng = _engine(serving_params, max_recoveries=0)
+            with pytest.raises(DeviceLossError):
+                eng.serve(_trace())
+
+    def test_recovery_disabled_reraises(self, serving_params):
+        with chaos.ServingDeviceLoss(at_step=2):
+            eng = _engine(serving_params, recover_on_fault=False)
+            with pytest.raises(DeviceLossError):
+                eng.serve(_trace())
+
+    def test_snapshot_restore_round_trip_with_poisoned_pool(
+            self, serving_params, control_tokens):
+        """snapshot → JSON → restore into a fresh engine whose pool is
+        sentinel-poisoned → continue: bitwise the control's streams.
+        The poison proves restore depends on NOTHING in the old pool —
+        KV pages are deliberately not part of the snapshot."""
+        src = _engine(serving_params)
+        tr = _trace()
+        for r in tr:
+            src.submit_request(r)
+        for _ in range(4):
+            src.step()
+        snap = json.loads(json.dumps(src.snapshot()))  # serializability pin
+        dst = _engine(serving_params)
+        dst.cache.k = jnp.full_like(dst.cache.k, 1e3)
+        dst.cache.v = jnp.full_like(dst.cache.v, 1e3)
+        restored = dst.restore(snap)
+        dst.run()
+        assert restored                         # something was live
+        for r in restored:
+            assert list(r.generated) == control_tokens[r.rid], r.rid
+
+    @pytest.mark.slow  # every cut boundary incl. done-but-unretired window
+    def test_snapshot_restore_at_every_boundary(self, serving_params,
+                                                control_tokens):
+        for cut in range(1, 12):
+            src = _engine(serving_params)
+            tr = _trace()
+            for r in tr:
+                src.submit_request(r)
+            for _ in range(cut):
+                if src.sched.idle:
+                    break
+                src.step()
+            snap = json.loads(json.dumps(src.snapshot()))
+            dst = _engine(serving_params)
+            dst.cache.k = jnp.full_like(dst.cache.k, 1e3)
+            dst.cache.v = jnp.full_like(dst.cache.v, 1e3)
+            restored = dst.restore(snap)
+            dst.run()
+            for r in restored:
+                assert list(r.generated) == control_tokens[r.rid], (
+                    cut, r.rid)
+
+    def test_restore_into_busy_engine_refuses(self, serving_params):
+        src = _engine(serving_params)
+        src.submit([1, 2], 2)
+        snap = src.snapshot()
+        busy = _engine(serving_params)
+        busy.submit([3, 4], 2)
+        with pytest.raises(RuntimeError, match="busy"):
+            busy.restore(snap)
+        fresh = _engine(serving_params)
+        with pytest.raises(ValueError, match="format"):
+            fresh.restore({"format": 99})
+
+
+# ---------------------------------------------------------------------------
+# Timeout storm: no page leak, bounded queue, stream validates
+# ---------------------------------------------------------------------------
+
+
+class TestTimeoutStorm:
+    def test_storm_leaves_every_page_reallocatable(self, serving_params):
+        from apex_tpu import telemetry as tel
+
+        mem = tel.MemorySink()
+        bus = tel.TelemetryBus(run_id="storm", sinks=[mem])
+        eng = ServingEngine(CFG, serving_params, num_pages=16, page_size=8,
+                            max_batch=2, prefill_budget=CFG.max_position,
+                            clock=SimClock(0.25), telemetry=bus,
+                            max_queue=6)
+        tr = poisson_trace(13, 24, rate=50.0, prompt_len=(4, 12),
+                           max_new=(3, 10), vocab_size=CFG.vocab_size,
+                           deadline_s=(1.0, 5.0))
+        eng.serve(tr)
+        reasons = {}
+        for r in tr:
+            reasons[r.finish_reason] = reasons.get(r.finish_reason, 0) + 1
+        # the storm must exercise every drop path AND still serve
+        assert reasons.get("rejected", 0) > 0, reasons
+        assert reasons.get("timeout", 0) > 0, reasons
+        assert (reasons.get("length", 0) + reasons.get("eos", 0)) > 0, reasons
+        # no leak: pool fully drained and the WHOLE pool allocatable
+        # in one take
+        assert eng.cache.pages_used == 0
+        pages = eng.cache.allocate(eng.cache.num_pages - 1, owner=-1)
+        assert len(pages) == eng.cache.num_pages - 1
+        for e in mem.events:
+            tel.validate_event(e)
+        types = {e["type"] for e in mem.events}
+        assert {"request_reject", "request_timeout"} <= types
+
+    def test_summarize_and_diff_render_overload_health(
+            self, serving_params, tmp_path):
+        from apex_tpu import telemetry as tel
+        from apex_tpu.telemetry.__main__ import main as tel_cli
+
+        path = str(tmp_path / "storm.jsonl")
+        bus = tel.TelemetryBus(run_id="storm-sum",
+                               sinks=[tel.JsonlSink(path)])
+        eng = ServingEngine(CFG, serving_params, num_pages=16, page_size=8,
+                            max_batch=2, prefill_budget=CFG.max_position,
+                            clock=SimClock(0.25), telemetry=bus,
+                            max_queue=6)
+        eng.serve(poisson_trace(13, 24, rate=50.0, prompt_len=(4, 12),
+                                max_new=(3, 10),
+                                vocab_size=CFG.vocab_size,
+                                deadline_s=(1.0, 5.0)))
+        bus.close()
+        assert tel_cli(["validate", path]) == 0   # acceptance contract
+        s = tel.summarize_file(path)
+        assert s["serving_sheds"] > 0
+        assert s["serving_timeouts"] > 0
+        assert s["serving_rejects"] > 0
+        assert 0.0 <= s["serving_deadline_hit_rate"] < 1.0
+        out = tel.format_summary(s)
+        assert "shed" in out and "timeout" in out and "deadline hit" in out
+        # the --diff table carries a deadline-hit-rate row
+        diff = tel.format_diff(s, s)
+        assert "deadline hit" in diff
+
+
+# ---------------------------------------------------------------------------
+# Decode-loop watchdog
+# ---------------------------------------------------------------------------
+
+
+class TestDecodeWatchdog:
+    def test_wedged_decode_escalates_instead_of_hanging(
+            self, serving_params):
+        # margins follow the PR 6 de-flaked watchdog case
+        # (timeout=1.0 / delay=2.5, executable warmed before arming)
+        reports = []
+        wd = Watchdog(timeout=1.0, on_hang=reports.append,
+                      poll_interval=0.02, devices=[0])
+        eng = _engine(serving_params, watchdog=wd)
+        eng.warmup()    # compile outside the armed region
+        with chaos.SlowDecode(at_step=2, delay=2.5):
+            with wd:
+                reqs = [eng.submit([1, 2, 3], 4), eng.submit([4, 5], 4)]
+                eng.run()
+        assert wd.expired and reports, "watchdog never fired"
+        assert reports[0]["timeout"] == 1.0
+        # the wedge cleared (injected sleep ended): serving completed
+        assert all(len(r.generated) == 4 for r in reqs)
+
+    def test_unhandled_overrun_raises_at_next_step(self, serving_params):
+        # no handler / on_hang: the overrun must surface as
+        # WatchdogTimeout on the next arm — a hang is never silent
+        wd = Watchdog(timeout=0.8, poll_interval=0.02, devices=[0])
+        eng = _engine(serving_params, watchdog=wd)
+        eng.warmup()
+        with chaos.SlowDecode(at_step=1, delay=2.0):
+            with wd:
+                eng.submit([1, 2, 3], 6)
+                with pytest.raises(WatchdogTimeout):
+                    eng.run()
+
+
+# ---------------------------------------------------------------------------
+# Schema: the new event types keep the closed-set discipline
+# ---------------------------------------------------------------------------
+
+
+class TestServingEventSchema:
+    def _stamp(self, type_, **payload):
+        ev = {"type": type_, "run_id": "r", "step": 0, "t": 0.0,
+              "ts": 0.0, "mesh": {}}
+        ev.update(payload)
+        return ev
+
+    def test_new_events_validate(self):
+        from apex_tpu.telemetry import validate_event
+
+        validate_event(self._stamp("request_reject", rid=1,
+                                   reason="queue_full", queue_depth=3))
+        validate_event(self._stamp("request_timeout", rid=1,
+                                   where="queued", overshoot_ms=1.5))
+        validate_event(self._stamp("serving_recovery", cause="device_loss",
+                                   pool_rebuilt=True, running_restored=2,
+                                   waiting_restored=1))
+
+    def test_pool_rebuilt_must_be_a_real_bool(self):
+        from apex_tpu.telemetry import validate_event
+        from apex_tpu.telemetry.schema import SchemaError
+
+        with pytest.raises(SchemaError, match="pool_rebuilt"):
+            validate_event(self._stamp(
+                "serving_recovery", cause="device_loss", pool_rebuilt=1,
+                running_restored=2, waiting_restored=1))
+
+    def test_missing_required_fields_rejected(self):
+        from apex_tpu.telemetry import validate_event
+        from apex_tpu.telemetry.schema import SchemaError
+
+        with pytest.raises(SchemaError, match="where"):
+            validate_event(self._stamp("request_timeout", rid=1,
+                                       overshoot_ms=0.0))
+        with pytest.raises(SchemaError, match="queue_depth"):
+            validate_event(self._stamp("request_reject", rid=1,
+                                       reason="queue_full"))
+
+    def test_deadline_hit_rides_retire_as_bool(self, serving_params):
+        from apex_tpu import telemetry as tel
+
+        mem = tel.MemorySink()
+        bus = tel.TelemetryBus(run_id="dh", sinks=[mem])
+        eng = _engine(serving_params, telemetry=bus)
+        eng.submit([1, 2, 3], 3, deadline_s=1e6)   # generous: must hit
+        eng.submit([4, 5, 6], 3)                   # no deadline: absent
+        eng.run()
+        retires = {e["rid"]: e for e in mem.events
+                   if e["type"] == "request_retire"}
+        assert retires[0]["deadline_hit"] is True
+        assert "deadline_hit" not in retires[1]
+        for e in mem.events:
+            tel.validate_event(e)
