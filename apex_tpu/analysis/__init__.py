@@ -1,0 +1,69 @@
+"""apex_tpu.analysis — project-invariant linter + hot-path sanitizer.
+
+Ten PRs of hard-won invariants — the closed telemetry event set with
+bool-not-int discipline (PR 4), buffer donation on pool-sized jit
+calls (PR 8), seeded-only randomness in every bitwise-contract module,
+one-device-fetch-per-window in hot loops — enforced as build-time
+checks instead of reviewer memory (ISSUE 11; the reference encodes the
+same kind of discipline as setup.py build-time feature gates,
+SURVEY §L0).
+
+Two halves:
+
+- **static** — an AST-based linter with a project-specific rule
+  catalog (:mod:`~apex_tpu.analysis.rules`: HS001 host-sync-in-hot-
+  path, ND001 unseeded nondeterminism, DN001 missing donation, TL001
+  telemetry schema drift, TH001 lock discipline, EX001 exception
+  swallowing), inline ``# lint: disable=RULE`` suppression, and a
+  committed baseline of documented exceptions.  CLI::
+
+      python -m apex_tpu.analysis lint apex_tpu/ [--baseline FILE]
+                                       [--json] [--no-baseline]
+      python -m apex_tpu.analysis rules
+
+  Exit 0 = clean against the baseline (the tier-1 CI gate), 1 =
+  findings.  The linter never imports the modules it checks — it is
+  AST-only and runs in seconds.
+
+- **runtime** — :func:`hot_path_guard`, a context manager composing
+  ``jax.transfer_guard`` with the PR 4 recompile listener (plus a
+  CPU-effective host-fetch tripwire) to fail a test on any unexpected
+  host transfer or recompile inside a guarded region.  It is what
+  *enforces by construction* the serving engine's two-compiled-shapes
+  contract and the flagship step's steady-state no-sync property.
+
+See docs/analysis.md for the rule catalog (with the incident each
+rule encodes), suppression/baseline syntax, and CI wiring.
+"""
+
+from apex_tpu.analysis.framework import (  # noqa: F401
+    Baseline,
+    Finding,
+    LintResult,
+    Rule,
+    default_rules,
+    lint_paths,
+    lint_source,
+    normalize_path,
+)
+from apex_tpu.analysis.rules import RULES  # noqa: F401
+from apex_tpu.analysis.runtime import (  # noqa: F401
+    GuardReport,
+    HotPathViolation,
+    hot_path_guard,
+)
+
+__all__ = [
+    "Baseline",
+    "Finding",
+    "GuardReport",
+    "HotPathViolation",
+    "LintResult",
+    "RULES",
+    "Rule",
+    "default_rules",
+    "hot_path_guard",
+    "lint_paths",
+    "lint_source",
+    "normalize_path",
+]

@@ -1,0 +1,113 @@
+"""CLI: ``python -m apex_tpu.analysis lint [paths] [--baseline FILE]``.
+
+The exit code IS the CI gate: 0 = clean against the baseline, 1 =
+non-baselined findings (or stale baseline entries under ``--strict-
+baseline``), 2 = usage error.  ``--json`` emits a machine-readable
+report for tooling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from apex_tpu.analysis.framework import (Baseline, default_rules,
+                                         lint_paths)
+
+#: The committed baseline's conventional home: the repo root (the
+#: directory holding the ``apex_tpu`` package).
+DEFAULT_BASELINE = "analysis_baseline.json"
+
+
+def _package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _find_default_baseline() -> Optional[str]:
+    for root in (os.getcwd(), os.path.dirname(_package_root())):
+        p = os.path.join(root, DEFAULT_BASELINE)
+        if os.path.isfile(p):
+            return p
+    return None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m apex_tpu.analysis",
+        description="Project-invariant linter (ISSUE 11). "
+                    "See docs/analysis.md for the rule catalog.")
+    sub = parser.add_subparsers(dest="cmd")
+
+    lint = sub.add_parser("lint", help="lint files/dirs; exit 1 on "
+                                       "non-baselined findings")
+    lint.add_argument("paths", nargs="*",
+                      help="files or directories (default: the "
+                           "apex_tpu package)")
+    lint.add_argument("--baseline", default=None,
+                      help=f"baseline JSON (default: {DEFAULT_BASELINE}"
+                           " in cwd or next to the package)")
+    lint.add_argument("--no-baseline", action="store_true",
+                      help="ignore any baseline (show everything)")
+    lint.add_argument("--json", action="store_true", dest="as_json",
+                      help="machine-readable report on stdout")
+    lint.add_argument("--strict-baseline", action="store_true",
+                      help="stale baseline entries also fail the gate")
+
+    sub.add_parser("rules", help="print the rule catalog")
+
+    args = parser.parse_args(argv)
+    if args.cmd == "rules":
+        for rule in default_rules():
+            print(f"{rule.id}  {rule.title}")
+            print(f"       {rule.rationale}")
+        return 0
+    if args.cmd != "lint":
+        parser.print_help()
+        return 2
+
+    paths = args.paths or [_package_root()]
+    baseline = None
+    if not args.no_baseline:
+        bpath = args.baseline or _find_default_baseline()
+        if args.baseline and not os.path.isfile(args.baseline):
+            print(f"baseline not found: {args.baseline}",
+                  file=sys.stderr)
+            return 2
+        if bpath:
+            baseline = Baseline.load(bpath)
+
+    try:
+        result = lint_paths(paths, baseline=baseline)
+    except FileNotFoundError as e:
+        print(f"no such path: {e}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps({
+            "files": result.files,
+            "findings": [f.to_json() for f in result.findings],
+            "baselined": [f.to_json() for f in result.baselined],
+            "stale_baseline": result.stale_baseline,
+        }, indent=2))
+    else:
+        for f in result.findings:
+            print(f.format())
+        for e in result.stale_baseline:
+            print(f"stale baseline entry (matched nothing): "
+                  f"{e['rule']} {e['path']} match={e['match']!r}")
+        print(f"{len(result.findings)} finding(s) over {result.files} "
+              f"file(s) ({len(result.baselined)} baselined, "
+              f"{len(result.stale_baseline)} stale baseline entr"
+              f"{'y' if len(result.stale_baseline) == 1 else 'ies'})")
+    if result.findings:
+        return 1
+    if args.strict_baseline and result.stale_baseline:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

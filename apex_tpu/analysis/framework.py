@@ -1,0 +1,281 @@
+"""Rule framework for the project-invariant linter (ISSUE 11).
+
+The moving parts, each deliberately small:
+
+- :class:`Finding` — one violation: rule id, span, message, and the
+  offending source line (the ``snippet`` is also the baseline-matching
+  anchor, so baselines survive line-number drift);
+- :class:`Rule` — the fixture-testable interface: ``check(tree,
+  source, path) -> Iterable[Finding]``.  Rules are pure AST walkers:
+  the linter NEVER imports the modules it checks (that is what keeps
+  the tier-1 lint gate an AST-speed step, and what lets it lint a
+  module whose imports would need a TPU);
+- inline suppression — ``# lint: disable=RULE[,RULE…]`` on the
+  offending line (or on a comment-only line immediately above it)
+  waives named rules for that line.  Use it for one-off local
+  exceptions; use the baseline for repo-level documented ones;
+- :class:`Baseline` — the committed ledger of documented exceptions
+  (``analysis_baseline.json``).  Each entry names the rule, the file,
+  a ``match`` substring of the offending line, and a one-line
+  ``justification``; entries that stop matching anything are reported
+  as STALE so the baseline cannot silently outlive its exceptions.
+
+``lint_source`` / ``lint_paths`` are the runners; the CLI in
+``__main__`` turns them into an exit-code CI gate.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: ``# lint: disable=HS001`` / ``# lint: disable=HS001,ND001``
+DISABLE_RE = re.compile(r"#\s*lint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source span."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_json(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``title``/``rationale`` and
+    implement :meth:`check`.  ``rationale`` names the incident the rule
+    encodes — a rule nobody can justify is a rule nobody will keep
+    green (docs/analysis.md carries the catalog)."""
+
+    id: str = "XX000"
+    title: str = ""
+    rationale: str = ""
+
+    def check(self, tree: ast.AST, source: str,
+              path: str) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    # -- helper ----------------------------------------------------------
+
+    def finding(self, path: str, node: ast.AST, message: str,
+                source: str = "") -> Finding:
+        line = getattr(node, "lineno", 0)
+        col = getattr(node, "col_offset", 0)
+        snippet = ""
+        if source and line:
+            lines = source.splitlines()
+            if 0 < line <= len(lines):
+                snippet = lines[line - 1].strip()
+        return Finding(self.id, path, line, col, message, snippet)
+
+
+def suppressed_lines(source: str) -> Dict[int, Set[str]]:
+    """line number -> rule ids waived there.  A ``# lint: disable=``
+    on a comment-only line also covers the next line (the black-
+    friendly form when the offending line has no room)."""
+    out: Dict[int, Set[str]] = {}
+    for i, ln in enumerate(source.splitlines(), 1):
+        m = DISABLE_RE.search(ln)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        out.setdefault(i, set()).update(rules)
+        if ln.strip().startswith("#"):
+            out.setdefault(i + 1, set()).update(rules)
+    return out
+
+
+def normalize_path(path: str) -> str:
+    """Repo-relative posix path: everything from the last ``apex_tpu/``
+    component on (baseline entries and findings agree on this form no
+    matter what cwd/absolute prefix the linter was invoked with)."""
+    p = str(path).replace(os.sep, "/")
+    i = p.rfind("apex_tpu/")
+    return p[i:] if i >= 0 else p
+
+
+class Baseline:
+    """The committed documented-exception ledger.
+
+    JSON shape::
+
+        {"format": 1,
+         "entries": [{"rule": "HS001",
+                      "path": "apex_tpu/serving/engine.py",
+                      "match": "np.asarray(next_tok)",
+                      "justification": "the one per-step token fetch"}]}
+
+    An entry suppresses findings with the same rule id and path whose
+    source line contains ``match``.  Matching is content-anchored, not
+    line-anchored, so ordinary edits elsewhere in the file do not
+    invalidate the baseline — but deleting the offending line makes
+    the entry STALE (reported, so baselines stay honest)."""
+
+    def __init__(self, entries: Sequence[Dict]):
+        self.entries: List[Dict] = list(entries)
+        self._hits = [0] * len(self.entries)
+        for i, e in enumerate(self.entries):
+            for key in ("rule", "path", "match", "justification"):
+                if not isinstance(e.get(key), str) or not e[key]:
+                    raise ValueError(
+                        f"baseline entry {i} missing/empty {key!r}: {e}")
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path) as f:
+            doc = json.load(f)
+        if doc.get("format") != 1:
+            raise ValueError(
+                f"unknown baseline format {doc.get('format')!r} in {path}")
+        return cls(doc.get("entries", []))
+
+    def matches(self, finding: Finding) -> bool:
+        # match against the source line OR the message — rules whose
+        # offending line is generic (an `except Exception:` handler)
+        # anchor on the message, which names the enclosing function
+        for i, e in enumerate(self.entries):
+            if (e["rule"] == finding.rule
+                    and e["path"] == normalize_path(finding.path)
+                    and (e["match"] in finding.snippet
+                         or e["match"] in finding.message)):
+                self._hits[i] += 1
+                return True
+        return False
+
+    def stale_entries(self) -> List[Dict]:
+        """Entries that matched nothing in the last run — the exception
+        they documented no longer exists; delete them."""
+        return [e for e, n in zip(self.entries, self._hits) if n == 0]
+
+
+@dataclasses.dataclass
+class LintResult:
+    """Everything a caller (CLI, CI test) needs to judge a run."""
+
+    findings: List[Finding]            # NOT baselined — these gate
+    baselined: List[Finding]           # matched a baseline entry
+    stale_baseline: List[Dict]         # baseline entries matching nothing
+    files: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def default_rules() -> List[Rule]:
+    from apex_tpu.analysis.rules import RULES
+
+    return [cls() for cls in RULES]
+
+
+def lint_source(source: str, path: str,
+                rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    """Lint one source string (the fixture-test entry point).  Inline
+    suppressions are applied; baseline matching is the caller's job."""
+    rules = list(rules) if rules is not None else default_rules()
+    tree = ast.parse(source, filename=path)
+    norm = normalize_path(path)
+    sup = suppressed_lines(source)
+    out: List[Finding] = []
+    for rule in rules:
+        for f in rule.check(tree, source, norm):
+            if rule.id in sup.get(f.line, ()):
+                continue
+            out.append(f)
+    return sorted(out, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+        else:
+            raise FileNotFoundError(p)
+
+
+def lint_paths(paths: Sequence[str], *,
+               rules: Optional[Sequence[Rule]] = None,
+               baseline: Optional[Baseline] = None) -> LintResult:
+    """Lint files/directories; split findings against the baseline."""
+    rules = list(rules) if rules is not None else default_rules()
+    gating: List[Finding] = []
+    waived: List[Finding] = []
+    files = 0
+    for path in iter_py_files(paths):
+        files += 1
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        for finding in lint_source(source, path, rules):
+            if baseline is not None and baseline.matches(finding):
+                waived.append(finding)
+            else:
+                gating.append(finding)
+    stale = baseline.stale_entries() if baseline is not None else []
+    return LintResult(findings=gating, baselined=waived,
+                      stale_baseline=stale, files=files)
+
+
+# -- shared AST helpers (used by rules.py and by rule authors) -----------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain; None when the chain roots
+    in anything else (a call result, a subscript…)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)
+
+
+def call_attr(node: ast.Call) -> Optional[str]:
+    """The trailing attribute of a method-style call (``x.item()`` ->
+    ``item``) regardless of what the receiver expression is."""
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return None
+
+
+def walk_functions(tree: ast.AST) -> Iterable[Tuple[ast.AST, List[str]]]:
+    """Yield every (Async)FunctionDef with its enclosing name stack
+    (outermost first), lambdas excluded."""
+
+    def rec(node: ast.AST, stack: List[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, stack
+                yield from rec(child, stack + [child.name])
+            elif isinstance(child, ast.ClassDef):
+                yield from rec(child, stack + [child.name])
+            else:
+                yield from rec(child, stack)
+
+    yield from rec(tree, [])

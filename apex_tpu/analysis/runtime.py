@@ -1,0 +1,181 @@
+"""Runtime sanitizer: ``hot_path_guard`` — fail the test, not the SLO.
+
+The static rules (``rules.py``) catch what an AST can see; this is the
+other half, for the invariants only a live region can prove:
+
+- **no recompiles** — the serving engine's contract is exactly TWO
+  compiled shapes for its lifetime (PR 8), and the flagship train
+  step's steady state is zero compiles after the first step.  The
+  guard counts XLA backend compiles via the PR 4
+  :func:`~apex_tpu.telemetry.install_recompile_listener` (callback-
+  only mode, no bus needed) and raises :class:`HotPathViolation` on
+  exit when the region compiled more than ``max_recompiles`` times;
+- **no host syncs** — composes two mechanisms, because they cover
+  different backends:
+
+  1. ``jax.transfer_guard(transfers)`` — the runtime's own guard.  On
+     device backends it makes any implicit transfer raise at the
+     offending call.  On the CPU backend transfers are zero-copy and
+     the runtime does NOT guard them — which is exactly where CI runs;
+  2. a Python-level **host-fetch tripwire**: for the guarded region,
+     ``jax.device_get``, ``jax.block_until_ready``, and the jax array
+     ``.item()``/``.block_until_ready()`` methods raise
+     :class:`HotPathViolation` immediately.  This works on every
+     backend, so the CPU test tier can pin (and seed-violate) the
+     no-sync property deterministically.
+
+  Known limit: a ``np.asarray(device_value)`` goes through numpy's C
+  buffer path and only the real transfer guard sees it — the CPU tier
+  catches it statically instead (HS001).
+
+Usage (the contracts ISSUE 11 pins in ``tests/L0/test_analysis.py``)::
+
+    engine.warmup()                    # both shapes compile here
+    with hot_path_guard("serving lifetime", transfers=None):
+        engine.serve(trace)            # any further compile raises
+
+    step(state, batch)                 # first call compiles
+    with hot_path_guard("steady state") as guard:
+        for b in batches:
+            state, loss = step(state, b)   # no sync, no recompile
+    assert guard.recompiles == 0
+
+The tripwire patches process-global attributes for the duration of the
+region — guard one region at a time from the main thread (tests), not
+concurrent production threads; production enforcement on device
+backends is ``jax.transfer_guard`` alone (``tripwire=False``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import List, Optional
+
+__all__ = ["HotPathViolation", "GuardReport", "hot_path_guard"]
+
+
+class HotPathViolation(AssertionError):
+    """A guarded hot region host-synced or recompiled unexpectedly."""
+
+
+class GuardReport:
+    """What the guarded region did: compile walls (seconds) and the
+    first host-sync description (when ``raise_on_sync=False``)."""
+
+    def __init__(self, label: str):
+        self.label = label
+        self.compile_s: List[float] = []
+        self.syncs: List[str] = []
+
+    @property
+    def recompiles(self) -> int:
+        return len(self.compile_s)
+
+
+def _patch_host_fetch(report: GuardReport, raise_on_sync: bool):
+    """Install the host-fetch tripwire; returns an undo callable."""
+    import jax
+
+    def trip(what: str):
+        report.syncs.append(what)
+        if raise_on_sync:
+            raise HotPathViolation(
+                f"host sync `{what}` inside guarded hot path "
+                f"'{report.label}' — fetch outside the region or once "
+                "per logging window (HS001's runtime twin)")
+
+    orig_get = jax.device_get
+    orig_block = jax.block_until_ready
+
+    def guarded_get(*a, **k):
+        trip("jax.device_get")
+        return orig_get(*a, **k)
+
+    def guarded_block(*a, **k):
+        trip("jax.block_until_ready")
+        return orig_block(*a, **k)
+
+    jax.device_get = guarded_get
+    jax.block_until_ready = guarded_block
+
+    undo_methods = []
+    try:
+        import jaxlib.xla_extension as _xe
+
+        cls = _xe.ArrayImpl
+        for meth in ("item", "block_until_ready"):
+            orig = getattr(cls, meth, None)
+            if orig is None:
+                continue
+
+            def make(meth=meth, orig=orig):
+                def guarded(self, *a, **k):
+                    trip(f"Array.{meth}")
+                    return orig(self, *a, **k)
+                return guarded
+
+            setattr(cls, meth, make())
+            undo_methods.append((cls, meth, orig))
+    except Exception:  # pragma: no cover — jaxlib layout moved; the
+        pass           # function-level wraps above still apply
+
+    def undo():
+        jax.device_get = orig_get
+        jax.block_until_ready = orig_block
+        # restore uses the same setattr that installed the wrapper, so
+        # it cannot fail where installation succeeded
+        for cls, meth, orig in undo_methods:
+            setattr(cls, meth, orig)
+
+    return undo
+
+
+@contextlib.contextmanager
+def hot_path_guard(label: str = "hot path", *,
+                   max_recompiles: int = 0,
+                   transfers: Optional[str] = "disallow",
+                   tripwire: bool = True,
+                   raise_on_sync: bool = True,
+                   telemetry=None):
+    """Guard a region against unexpected recompiles and host syncs.
+
+    ``max_recompiles`` — XLA backend compiles tolerated inside the
+    region (0 = the steady-state contract); exceeding it raises
+    :class:`HotPathViolation` on exit, with the compile walls in the
+    message.  ``transfers`` — a ``jax.transfer_guard`` level
+    (``"disallow"``, ``"log"``, …) or None to leave transfers
+    unguarded (the serving engine legitimately moves one token batch
+    per step).  ``tripwire`` — install the Python-level host-fetch
+    tripwire (CPU-effective; see module doc); ``raise_on_sync=False``
+    records syncs on the report instead of raising.  ``telemetry`` —
+    optional bus; compiles inside the region additionally emit
+    ``recompile`` events.
+
+    Yields a :class:`GuardReport` (``recompiles``, ``compile_s``,
+    ``syncs``)."""
+    import jax
+
+    from apex_tpu.telemetry.bus import install_recompile_listener
+
+    report = GuardReport(label)
+    uninstall = install_recompile_listener(
+        telemetry, on_duration=report.compile_s.append)
+    undo_tripwire = (_patch_host_fetch(report, raise_on_sync)
+                     if tripwire else lambda: None)
+    try:
+        if transfers is None:
+            yield report
+        else:
+            with jax.transfer_guard(transfers):
+                yield report
+    finally:
+        undo_tripwire()
+        uninstall()
+    if report.recompiles > max_recompiles:
+        walls = ", ".join(f"{s * 1e3:.1f}ms" for s in report.compile_s)
+        raise HotPathViolation(
+            f"{report.recompiles} XLA compile(s) inside guarded hot "
+            f"path '{label}' (allowed {max_recompiles}) — compile "
+            f"walls: [{walls}].  A steady-state region must reuse its "
+            "compiled executables; a new shape mid-region is the "
+            "silent step-time cliff the recompile listener exists for")

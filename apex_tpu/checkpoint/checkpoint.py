@@ -394,7 +394,12 @@ def save_checkpoint(
         if mesh_shape is None:
             try:  # best-effort topology evidence for the manifest
                 mesh_shape = dict(leaf.sharding.mesh.shape)
-            except Exception:
+            except (AttributeError, TypeError):
+                # numpy leaves have no .sharding and single-device
+                # shardings no .mesh — those are the documented
+                # "no topology" cases.  Anything else must surface
+                # (EX001: the broad except here would also have
+                # swallowed a genuinely broken mesh mid-save)
                 pass
         val = np.asarray(jax.device_get(leaf))
         entry = {"kind": "array", "dtype": str(val.dtype),

@@ -51,7 +51,12 @@ def global_grad_norm(tree: Any) -> Optional[float]:
                 if not jnp.issubdtype(arr.dtype, jnp.floating):
                     continue
                 arr = arr.astype(np.float32)
-            except Exception:
+            except (ImportError, TypeError, ValueError):
+                # an exotic dtype jnp can't classify/convert is a
+                # legitimate skip; anything else (EX001: a broad
+                # except here once swallowed EVERY error) must surface
+                # — a silently under-reported grad norm poisons the
+                # divergence diagnostic it feeds
                 continue
         seen = True
         total += float(np.sum(np.square(arr.astype(np.float64))))

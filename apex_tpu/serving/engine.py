@@ -1,8 +1,8 @@
 """The serving engine: the device-facing half of continuous batching.
 
 :class:`ServingEngine` turns the :class:`~apex_tpu.serving.scheduler.
-ContinuousBatchingScheduler`'s host-side decisions into exactly two
-compiled device functions, each traced ONCE for the engine's lifetime:
+ContinuousBatchingScheduler`'s host-side decisions into two compiled
+step functions, each traced ONCE for the engine's lifetime:
 
 * **prefill** — a fixed-width packed row (``[1, prefill_budget]``
   tokens + segment ids + per-segment positions) through
@@ -16,8 +16,12 @@ compiled device functions, each traced ONCE for the engine's lifetime:
   greedily.  Idle rows are pointed at the scratch page and ignored.
 
 Admitting, retiring, growing or preempting requests between steps
-never changes a device shape, so the serving lifetime sees exactly two
-XLA compilations.
+never changes a device shape, so after :meth:`ServingEngine.warmup`
+the serving lifetime sees ZERO further XLA compilations (the warmup
+compiles the two step functions plus the pool-fill scatter —
+``PagedKVCache.write_tokens`` — three executables total; the
+no-compile steady state is enforced by construction with
+:func:`apex_tpu.analysis.hot_path_guard` in the ISSUE 11 pin).
 
 **The isolation contract (and why prefill is one request per row).**
 The acceptance bar for this engine is bitwise: batched continuous
@@ -302,15 +306,25 @@ class ServingEngine:
     # -- device steps ------------------------------------------------------
 
     def warmup(self) -> float:
-        """Compile both device shapes before any request arrives (so
-        TTFT never carries jit-compile wall); returns the seconds
-        spent.  The decode warmup donates and rebinds the pool
-        buffers; its zero K/V lands in scratch page 0, which no reader
+        """Compile every device executable before any request arrives
+        (so TTFT never carries jit-compile wall); returns the seconds
+        spent.  That is THREE executables, not two: the prefill row,
+        the decode step, and the pool scatter that fills an admitted
+        request's pages (``PagedKVCache.write_tokens``) — the scatter
+        was the one warmup originally missed, surfacing as a hidden
+        ~70 ms compile on the FIRST admission's TTFT (caught by the
+        hot_path_guard serving-lifetime pin, ISSUE 11).  The scatter
+        and decode warmups write into scratch page 0, which no reader
         ever sees."""
         t0 = time.perf_counter()
         z = jnp.zeros((1, self.prefill_budget), jnp.int32)
-        jax.block_until_ready(self._prefill_fn(
-            self.params, z, z, z, jnp.zeros((), jnp.int32)))
+        _, wk0, wv0 = self._prefill_fn(
+            self.params, z, z, z, np.int32(0))
+        # warm the admission scatter with its real shapes: the warmup
+        # prefill's K/V row scattered into the scratch page
+        S = self.prefill_budget
+        self.cache.write_tokens(wk0, wv0, np.zeros((S,), np.int32),
+                                np.zeros((S,), np.int32))
         b = self.max_batch
         p_max = self.cache.max_pages_per_request
         _, wk, wv = self._decode_fn(
@@ -347,9 +361,13 @@ class ServingEngine:
         seg[0, :C] = 1
         positions = np.zeros((1, S), np.int32)
         positions[0, :C] = np.arange(C)
+        # np.int32 scalar, NOT jnp.asarray(C - 1): converting a python
+        # int eagerly compiles a tiny convert executable the warmup
+        # never built — a hidden ~60 ms stall on the first admission's
+        # TTFT (caught by hot_path_guard's serving-lifetime pin)
         next_tok, k, v = self._prefill_fn(
             self.params, jnp.asarray(tokens), jnp.asarray(seg),
-            jnp.asarray(positions), jnp.asarray(C - 1, jnp.int32))
+            jnp.asarray(positions), np.int32(C - 1))
         # packed position t -> (page, in-page offset); padding -> scratch
         pages = np.zeros((S,), np.int32)
         offsets = np.zeros((S,), np.int32)

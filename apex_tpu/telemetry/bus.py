@@ -34,46 +34,17 @@ import threading
 import time
 from typing import Any, Dict, Iterable, Optional
 
+from apex_tpu.telemetry.schema import EVENT_TYPES  # noqa: F401
+
 log = logging.getLogger("apex_tpu.telemetry")
 
-#: The typed event vocabulary.  ``emit`` rejects anything else — an
-#: event stream is only diffable/aggregatable if its types are closed.
-EVENT_TYPES = frozenset({
-    "run_start",       # loop (re)entered: config snapshot, start step
-    "run_end",         # loop exited: goodput buckets, stop reason
-    "step",            # one train step: wall split + windowed scalars
-    "ckpt_save",       # checkpoint write issued (blocking or async)
-    "ckpt_restore",    # restore completed (incl. elastic re-partition)
-    "skip",            # divergence guard skipped a non-finite step
-    "watchdog",        # collective watchdog fired: straggler report
-    "device_loss",     # mesh device(s) disappeared; elastic rebuild
-    "recompile",       # XLA backend compile observed mid-run
-    "fault_injected",  # chaos tier injected a fault (test streams)
-    "timers",          # pipeline-parallel Timers.log snapshot
-    "postmortem",      # flight-recorder flush header
-    "data_stall",      # input pipeline made the step wait (dry prefetch
-                       # queue, slow shard read, shard re-assignment)
-    "data_quarantine",  # a damaged record was skipped and counted
-    "request_admit",   # serving: request admitted (or re-admitted after
-                       # preemption) and prefilled into the page pool
-    "request_retire",  # serving: request finished (eos/length) with its
-                       # per-request TTFT/TPOT latency record
-    "decode_step",     # serving: one continuous-batching decode step
-                       # (batch width, tokens, page-pool occupancy)
-    "request_reject",  # serving: bounded submit queue refused a request
-                       # under overload (explicit shed, never silent
-                       # unbounded queue growth) — ISSUE 10
-    "request_timeout",  # serving: a request's deadline died — shed from
-                        # the queue or retired mid-flight with its pages
-                        # freed immediately — ISSUE 10
-    "serving_recovery",  # serving: engine rebuilt the KV pool and
-                         # restored live requests after a device loss /
-                         # page corruption mid-decode — ISSUE 10
-    "profile",         # ProfileSampler window: per-phase device ms,
-                       # exposed-collective ms, top-k ops (ISSUE 9)
-    "memory",          # ProfileSampler HBM sample: live/peak bytes from
-                       # device_memory_stats (absent fields = no stats)
-})
+# The typed event vocabulary (``EVENT_TYPES``) is DERIVED from the
+# single-sourced field-spec table in :mod:`apex_tpu.telemetry.schema`
+# (ISSUE 11): ``emit`` rejects anything outside it, and — because the
+# set is ``frozenset(EVENT_FIELDS)`` — an event type cannot be added
+# without its field spec, so the schema, the runtime validator, and
+# the ``apex_tpu.analysis`` TL001 lint rule can never drift apart.
+# Each type's meaning is documented next to its field spec there.
 
 
 class TelemetryError(ValueError):
@@ -309,16 +280,19 @@ class TelemetryBus:
         self.close()
 
 
-def install_recompile_listener(bus: TelemetryBus, on_duration=None):
+def install_recompile_listener(bus: Optional[TelemetryBus] = None,
+                               on_duration=None):
     """Emit a ``recompile`` event whenever the jax runtime reports an
     XLA backend compile — mid-run recompiles are the classic silent
     step-time cliff (a shape change recompiling a 1.3B step costs
     minutes).  ``on_duration(seconds)`` additionally feeds each compile
     to the caller (the train loops accumulate it and book it to the
     accountant's ``compile`` bucket, so compile wall measured inside a
-    step never counts as productive goodput).  Returns an
-    ``uninstall()`` callable; best-effort: on a jax without the
-    monitoring hooks it installs nothing and returns a no-op."""
+    step never counts as productive goodput).  ``bus`` may be ``None``
+    for callback-only use — :func:`apex_tpu.analysis.hot_path_guard`
+    counts compiles inside a guarded region without owning a stream.
+    Returns an ``uninstall()`` callable; best-effort: on a jax without
+    the monitoring hooks it installs nothing and returns a no-op."""
     try:
         from jax._src import monitoring as _mon
     except Exception:  # pragma: no cover — jax internals moved
@@ -327,8 +301,10 @@ def install_recompile_listener(bus: TelemetryBus, on_duration=None):
     def _listener(event: str, duration: float, **_kw) -> None:
         if event.endswith("backend_compile_duration"):
             try:
-                bus.emit("recompile", duration_ms=round(duration * 1e3, 3),
-                         source=event)
+                if bus is not None:
+                    bus.emit("recompile",
+                             duration_ms=round(duration * 1e3, 3),
+                             source=event)
                 if on_duration is not None:
                     on_duration(float(duration))
             except Exception:  # pragma: no cover — never break compile

@@ -1,17 +1,29 @@
-"""JSONL event-schema validator (CI/tooling tier).
+"""Telemetry event schema: the single-sourced field-spec tables.
 
 Telemetry is only useful if every producer agrees on the record shape —
-a stream a tool can't parse is a ``print`` with extra steps.  This is a
-small hand-rolled validator (no jsonschema dependency; the container
-rule is "stub or gate missing deps") enforcing:
+a stream a tool can't parse is a ``print`` with extra steps.  This
+module owns that contract (ISSUE 11 satellite):
 
-- the universal stamp every event carries (``type`` in
-  :data:`~apex_tpu.telemetry.bus.EVENT_TYPES`, ``run_id`` str,
-  ``step`` int-or-None, ``t``/``ts`` numbers, ``mesh`` dict);
-- per-type required payload fields with their types
-  (:data:`PAYLOAD_REQUIRED`);
-- JSON-serializability (an event that can't round-trip through
-  ``json`` would poison the sink file).
+- :data:`EVENT_FIELDS` — per event type, every known payload field with
+  its allowed types and whether it is required.  This is THE table:
+  :func:`validate_event` (the runtime/CI validator) and the
+  ``apex_tpu.analysis`` TL001 lint rule both consume it, so the schema
+  can never drift from the linter;
+- :data:`EVENT_TYPES` — **derived** from :data:`EVENT_FIELDS`
+  (``frozenset(EVENT_FIELDS)``), re-exported by
+  :mod:`apex_tpu.telemetry.bus` whose ``emit`` rejects anything else.
+  An event type therefore cannot exist without a field spec — the
+  drift the PR 4 → PR 10 era policed by reviewer memory is now
+  impossible by construction (pinned in ``tests/L0/test_analysis.py``);
+- the universal stamp every event carries (:data:`STAMP_REQUIRED`);
+- bool-not-int discipline: ``bool`` is an ``int`` subclass in Python,
+  so an int-typed field must explicitly reject bools and vice versa —
+  a ``1`` where the schema says ``True`` breaks every downstream
+  ``is True`` check and the ``--diff`` ratio math.
+
+This module is deliberately **stdlib-only and import-light**: the
+linter loads it without touching jax or any checked module, which is
+what keeps the lint gate an AST-speed CI step.
 
 Tests run every emitted event through :func:`validate_event`;
 :func:`validate_jsonl` checks a whole file (e.g. a postmortem).
@@ -20,11 +32,232 @@ Tests run every emitted event through :func:`validate_event`;
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, Iterable, List
-
-from apex_tpu.telemetry.bus import EVENT_TYPES
+from typing import Any, Dict, Iterable, List, NamedTuple
 
 NUMBER = (int, float)
+OPT_NUMBER = (int, float, type(None))
+
+
+class FieldSpec(NamedTuple):
+    """One payload field's contract: allowed types + requiredness.
+
+    ``required=False`` fields are OPTIONAL — absent entirely when the
+    producer has nothing to say (a one-token request has no TPOT, a
+    CPU backend has no HBM stats).  Optionality must be explicit in
+    the schema, never smuggled via sentinel values."""
+
+    types: tuple
+    required: bool = True
+
+
+def opt(*types) -> FieldSpec:
+    """An optional field spec (shorthand for the table below)."""
+    return FieldSpec(tuple(types), required=False)
+
+
+def req(*types) -> FieldSpec:
+    """A required field spec (shorthand for the table below)."""
+    return FieldSpec(tuple(types), required=True)
+
+
+#: The closed event vocabulary WITH its per-field contracts.  Every
+#: field a producer names literally at an emit site must appear here —
+#: the TL001 lint rule enforces that; ``validate_event`` type-checks
+#: required fields always and optional fields whenever present.
+EVENT_FIELDS: Dict[str, Dict[str, FieldSpec]] = {
+    # loop (re)entered: config snapshot, start step.  workload/config/
+    # fast come from the bench and example entrypoints (bench.py,
+    # pretrain_gpt.py) — the table covers EVERY producer in the repo,
+    # not just the apex_tpu package, or TL001 flags them
+    "run_start": {
+        "save_every": opt(int),
+        "async_saves": opt(bool),
+        "sharded": opt(bool),
+        "watchdog": opt(bool),
+        "guarded": opt(bool),
+        "workload": opt(str),
+        "config": opt(dict),
+        "fast": opt(bool),
+    },
+    # loop exited: goodput buckets, stop reason
+    "run_end": {
+        "goodput": req(*NUMBER),
+        "steps": req(int),
+        "wall_s": req(*NUMBER),
+        "reason": req(str),
+        "skips": opt(int),
+        "steps_per_sec": opt(*NUMBER),
+        "buckets_s": opt(dict),
+        "scalars": opt(dict),
+    },
+    # one train step: wall split + windowed scalars
+    "step": {
+        "step_ms": req(*NUMBER),
+        "compile_ms": opt(*NUMBER),
+        "data_wait_ms": opt(*NUMBER),
+        "skipped": opt(bool),
+        "scalars": opt(dict),
+        "timing": opt(str),
+    },
+    # checkpoint write issued (blocking or async)
+    "ckpt_save": {
+        "blocking": req(bool),
+        "wall_ms": opt(*NUMBER),
+    },
+    # restore completed (incl. elastic re-partition)
+    "ckpt_restore": {
+        "wall_ms": opt(*NUMBER),
+        "n_shards": opt(int),
+        "reason": opt(str),
+    },
+    # divergence guard skipped a non-finite step
+    "skip": {
+        "consecutive": req(int),
+        "total_skipped": req(int),
+        "total_steps": opt(int),
+        "grad_norm": opt(*OPT_NUMBER),
+        "loss_scale": opt(*OPT_NUMBER),
+    },
+    # collective watchdog fired: straggler report
+    "watchdog": {
+        "report": req(dict),
+    },
+    # mesh device(s) disappeared; elastic rebuild
+    "device_loss": {
+        "device_ids": req(list),
+        "survivors": opt(int),
+        "restarts": opt(int),
+        "recoverable": opt(bool),
+        "mesh_axes": opt(dict),
+    },
+    # XLA backend compile observed mid-run
+    "recompile": {
+        "duration_ms": opt(*NUMBER),
+        "source": opt(str),
+    },
+    # chaos tier injected a fault (test streams)
+    "fault_injected": {
+        "kind": req(str),
+        "event": opt(str),
+        "path": opt(str),
+        "device_ids": opt(list),
+        "at_poll": opt(int),
+        "at_step": opt(int),
+        "at_decode_step": opt(int),
+        "axis": opt(str, type(None)),
+        "delay_s": opt(*NUMBER),
+        "page": opt(int),
+        "use_signal": opt(bool),
+    },
+    # pipeline-parallel Timers.log snapshot
+    "timers": {
+        "timers_ms": req(dict),
+        "normalizer": opt(*NUMBER),
+    },
+    # flight-recorder flush header
+    "postmortem": {
+        "reason": req(str),
+        "ring_events": req(int),
+        "path": opt(str),
+        "watchdog": opt(dict),
+    },
+    # input pipeline made the step wait (dry prefetch queue, slow
+    # shard read, shard re-assignment)
+    "data_stall": {
+        "wait_ms": req(*NUMBER),
+        "cause": req(str),
+        "depth": opt(int),
+    },
+    # a damaged record was skipped and counted
+    "data_quarantine": {
+        "record_id": req(int),
+        "reason": req(str),
+        "total": req(int),
+        "rate": opt(*NUMBER),
+    },
+    # serving (ISSUE 8): latency fields (ttft_ms/tpot_ms on retire,
+    # step_ms/evicted on decode_step) are optional — a one-token
+    # request has no TPOT
+    "request_admit": {
+        "rid": req(int),
+        "context_tokens": req(int),
+        "pages": req(int),
+        "preemptions": req(int),
+    },
+    "request_retire": {
+        "rid": req(int),
+        "reason": req(str),
+        "new_tokens": req(int),
+        "preemptions": req(int),
+        "ttft_ms": opt(*NUMBER),
+        "tpot_ms": opt(*NUMBER),
+        # a REAL bool, present only when the request carried a deadline
+        "deadline_hit": opt(bool),
+    },
+    "decode_step": {
+        "batch": req(int),
+        "new_tokens": req(int),
+        "pool_used": req(int),
+        "pool_pages": req(int),
+        "evicted": opt(list),
+        "step_ms": opt(*NUMBER),
+    },
+    # serving resilience (ISSUE 10): overload rejects, deadline deaths
+    # (where = "queued" shed / "running" timeout), crash recovery.
+    # pool_rebuilt is a REAL bool (bool-not-int discipline)
+    "request_reject": {
+        "rid": req(int),
+        "reason": req(str),
+        "queue_depth": req(int),
+    },
+    "request_timeout": {
+        "rid": req(int),
+        "where": req(str),
+        "overshoot_ms": req(*NUMBER),
+    },
+    "serving_recovery": {
+        "cause": req(str),
+        "pool_rebuilt": req(bool),
+        "running_restored": req(int),
+        "waiting_restored": req(int),
+    },
+    # in-run attribution (ISSUE 9): the ProfileSampler's window result.
+    # exposed_collective_ms is the overlap-analysis headline;
+    # overhead_ms is the sampler's own host cost for this window (also
+    # booked to the `profile` goodput bucket)
+    "profile": {
+        "window_steps": req(int),
+        "phase_ms": req(dict),
+        "exposed_collective_ms": req(*NUMBER),
+        "collective_ms": req(*NUMBER),
+        "total_device_ms": req(*NUMBER),
+        "overhead_ms": req(*NUMBER),
+        "span_ms": opt(*NUMBER),
+        "n_ops": opt(int),
+        "top_ops": opt(list),
+    },
+    # HBM sample: stats_available is a REAL bool; byte fields are
+    # present only when the backend exposes memory_stats
+    "memory": {
+        "stats_available": req(bool),
+        "n_devices": req(int),
+        "live_bytes": opt(int),
+        "peak_bytes": opt(int),
+        "limit_bytes": opt(int),
+    },
+}
+
+#: The typed event vocabulary — DERIVED from the field table, so an
+#: event type without a field spec cannot exist.  ``bus.EVENT_TYPES``
+#: re-exports this object.
+EVENT_TYPES = frozenset(EVENT_FIELDS)
+
+#: Legacy view: per-type REQUIRED payload fields -> allowed types
+#: (kept for callers written against the pre-ISSUE-11 shape).
+PAYLOAD_REQUIRED: Dict[str, Dict[str, tuple]] = {
+    etype: {f: spec.types for f, spec in fields.items() if spec.required}
+    for etype, fields in EVENT_FIELDS.items()
+}
 
 #: Universal stamp: field -> allowed types (None allowed where noted).
 STAMP_REQUIRED: Dict[str, tuple] = {
@@ -36,60 +269,6 @@ STAMP_REQUIRED: Dict[str, tuple] = {
     "mesh": (dict,),
 }
 
-#: Per-type required payload fields -> allowed types.
-PAYLOAD_REQUIRED: Dict[str, Dict[str, tuple]] = {
-    "run_start": {},
-    "run_end": {"goodput": NUMBER, "steps": (int,), "wall_s": NUMBER,
-                "reason": (str,)},
-    "step": {"step_ms": NUMBER},
-    "ckpt_save": {"blocking": (bool,)},
-    "ckpt_restore": {},
-    "skip": {"consecutive": (int,), "total_skipped": (int,)},
-    "watchdog": {"report": (dict,)},
-    "device_loss": {"device_ids": (list,)},
-    "recompile": {},
-    "fault_injected": {"kind": (str,)},
-    "timers": {"timers_ms": (dict,)},
-    "postmortem": {"reason": (str,), "ring_events": (int,)},
-    "data_stall": {"wait_ms": NUMBER, "cause": (str,)},
-    "data_quarantine": {"record_id": (int,), "reason": (str,),
-                        "total": (int,)},
-    # serving events (ISSUE 8): latency fields (ttft_ms/tpot_ms on
-    # retire, step_ms/evicted on decode_step) are optional — a
-    # one-token request has no TPOT, and optionality must be explicit
-    # in the schema, not smuggled via sentinel values
-    "request_admit": {"rid": (int,), "context_tokens": (int,),
-                      "pages": (int,), "preemptions": (int,)},
-    "request_retire": {"rid": (int,), "reason": (str,),
-                       "new_tokens": (int,), "preemptions": (int,)},
-    "decode_step": {"batch": (int,), "new_tokens": (int,),
-                    "pool_used": (int,), "pool_pages": (int,)},
-    # serving resilience (ISSUE 10): overload rejects, deadline deaths
-    # (where = "queued" shed / "running" timeout), and crash recovery.
-    # pool_rebuilt is a REAL bool (bool-not-int discipline); the
-    # optional deadline_hit on request_retire is likewise a bool,
-    # present only when the request carried a deadline
-    "request_reject": {"rid": (int,), "reason": (str,),
-                       "queue_depth": (int,)},
-    "request_timeout": {"rid": (int,), "where": (str,),
-                        "overshoot_ms": NUMBER},
-    "serving_recovery": {"cause": (str,), "pool_rebuilt": (bool,),
-                         "running_restored": (int,),
-                         "waiting_restored": (int,)},
-    # in-run attribution (ISSUE 9): the ProfileSampler's window result.
-    # phase_ms maps phase -> device ms; exposed_collective_ms is the
-    # overlap-analysis headline; overhead_ms is the sampler's own host
-    # cost for this window (also booked to the `profile` goodput bucket)
-    "profile": {"window_steps": (int,), "phase_ms": (dict,),
-                "exposed_collective_ms": NUMBER,
-                "collective_ms": NUMBER, "total_device_ms": NUMBER,
-                "overhead_ms": NUMBER},
-    # HBM sample: stats_available is a REAL bool (bool-not-int
-    # discipline); live/peak/limit bytes are present only when the
-    # backend exposes memory_stats — optionality explicit, no sentinels
-    "memory": {"stats_available": (bool,), "n_devices": (int,)},
-}
-
 
 class SchemaError(ValueError):
     """An event violates the telemetry schema."""
@@ -99,9 +278,26 @@ def _type_names(types: tuple) -> str:
     return "/".join(t.__name__ for t in types)
 
 
+def _check_field(etype: str, field: str, v: Any, types: tuple) -> None:
+    # bool is an int subclass; an int-typed field must not accept it
+    if isinstance(v, bool) and bool not in types:
+        raise SchemaError(
+            f"{etype}.{field} must be {_type_names(types)}, got bool")
+    if not isinstance(v, types):
+        raise SchemaError(
+            f"{etype}.{field} must be {_type_names(types)}, got "
+            f"{type(v).__name__} ({v!r})")
+
+
 def validate_event(event: Any) -> Dict[str, Any]:
     """Validate one event dict; returns it (for chaining) or raises
-    :class:`SchemaError` naming the offending field."""
+    :class:`SchemaError` naming the offending field.
+
+    Required fields must be present with a spec-conforming type;
+    optional fields are type-checked whenever present.  Fields not in
+    the spec are tolerated at runtime (producers may attach ad-hoc
+    context via ``**payload``) — but fields named *literally* at an
+    emit site are held to the table by the TL001 lint rule."""
     if not isinstance(event, dict):
         raise SchemaError(f"event must be a dict, got {type(event).__name__}")
     for field, types in STAMP_REQUIRED.items():
@@ -112,22 +308,17 @@ def validate_event(event: Any) -> Dict[str, Any]:
                 f"stamp field {field!r} must be {_type_names(types)}, got "
                 f"{type(event[field]).__name__} ({event[field]!r})")
     etype = event["type"]
-    if etype not in EVENT_TYPES:
+    if etype not in EVENT_FIELDS:
         raise SchemaError(
             f"unknown event type {etype!r}; known: {sorted(EVENT_TYPES)}")
-    for field, types in PAYLOAD_REQUIRED[etype].items():
+    for field, spec in EVENT_FIELDS[etype].items():
         if field not in event:
-            raise SchemaError(
-                f"{etype} event missing required field {field!r}: {event}")
-        # bool is an int subclass; an int-typed field must not accept it
-        v = event[field]
-        if isinstance(v, bool) and bool not in types:
-            raise SchemaError(
-                f"{etype}.{field} must be {_type_names(types)}, got bool")
-        if not isinstance(v, types):
-            raise SchemaError(
-                f"{etype}.{field} must be {_type_names(types)}, got "
-                f"{type(v).__name__} ({v!r})")
+            if spec.required:
+                raise SchemaError(
+                    f"{etype} event missing required field {field!r}: "
+                    f"{event}")
+            continue
+        _check_field(etype, field, event[field], spec.types)
     try:
         json.dumps(event)
     except (TypeError, ValueError) as e:
