@@ -68,8 +68,16 @@ _NP_ROOTS = ("np", "numpy", "onp")
 #: accountant's fetch seam).  Nested helpers inherit hotness.
 HOT_PATH_FUNCTIONS: Dict[str, Set[str]] = {
     "apex_tpu/serving/engine.py": {
-        "_decode_batch", "_prefill_request", "_step_body"},
+        "_decode_batch", "_prefill_request", "_step_body",
+        # ISSUE 12: the speculative verify step, the chunked-prefill
+        # step, and the draft-proposal loop run at every decode
+        # boundary — same steady-state heat as _decode_batch
+        "_verify_batch", "_chunk_step", "_propose_drafts"},
     "apex_tpu/serving/kv_cache.py": {"_page_digest"},
+    # ISSUE 12: proposer lookup (per decode boundary per request) and
+    # the chunk splitter (per boundary)
+    "apex_tpu/serving/spec/proposer.py": {"propose", "_reindex"},
+    "apex_tpu/serving/scheduler.py": {"schedule_prefill"},
     "apex_tpu/transformer/testing/train_loop.py": {
         "run_resilient_training"},
     "apex_tpu/resilience/elastic.py": {"run_elastic_training"},

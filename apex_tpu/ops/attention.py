@@ -2350,10 +2350,19 @@ def flash_decode(
     [b, p_max] int32: each request's page list in cache order, rows
     padded with page 0 (the pool's reserved scratch page — see
     ``apex_tpu.serving.kv_cache``).  ``kv_len`` [b]: valid tokens per
-    request, INCLUDING the ``q_len`` query tokens, whose k/v must
-    already be appended to the cache; ``kv_len >= q_len`` is the
-    caller's contract.  Decode is causal by construction: query row i
-    sees columns ``[0, kv_len - q_len + i]``.
+    request, INCLUDING however many of the ``q_len`` query rows are
+    real; their k/v must already be appended to the cache.  Decode is
+    causal by construction: query row i sees columns
+    ``[0, kv_len - q_len + i]``.
+
+    ``kv_len < q_len`` is ALLOWED and part of the contract (both
+    routes guard the empty-window normalizer): rows whose causal
+    window is empty (``kv_len - q_len + i < 0``) return exact zeros.
+    The serving verify/chunk paths rely on this — they front-pad
+    short drafts/chunks into a fixed ``q_len`` window and discard the
+    pad rows' outputs (``PagedDecoder.extend``), so a row whose whole
+    sequence is shorter than the window must stay finite.  Pinned by
+    ``test_kv_len_shorter_than_window_is_exact_zeros``.
 
     Inference-only (no VJP — the serving path never differentiates);
     routing per :func:`flash_decode_route`, forceable via

@@ -13,11 +13,17 @@ Three composable layers, bottom-up:
   :meth:`~PagedKVCache.defrag` compaction.
 * :class:`ContinuousBatchingScheduler` + :class:`ServingEngine` —
   admission/growth/preemption/retirement policy, and the engine that
-  turns it into exactly two compiled device functions (fixed-shape
-  prefill and decode).
+  turns it into a fixed set of compiled device functions (prefill
+  row, decode step, admission scatter — plus, with
+  :class:`SpecConfig`, the speculative verify step and the
+  chunked-prefill step).
+* :mod:`apex_tpu.serving.spec` (ISSUE 12) — the draft–verify
+  subsystem: pluggable :class:`Proposer` drafts
+  (:class:`NgramProposer` suffix-cache baseline), exact greedy
+  verify-accept at ``q_len = k + 1``, chunked prefill.
 
 See docs/serving.md for the page-table layout, the admission policy,
-decode routing, and the bench methodology.
+decode routing, speculative decoding, and the bench methodology.
 """
 
 from apex_tpu.serving.engine import (  # noqa: F401
@@ -44,8 +50,16 @@ from apex_tpu.serving.scheduler import (  # noqa: F401
     QueueFullError,
     Request,
 )
+from apex_tpu.serving.spec import (  # noqa: F401
+    NgramProposer,
+    Proposer,
+    SpecConfig,
+)
 
 __all__ = [
+    "SpecConfig",
+    "Proposer",
+    "NgramProposer",
     "ServingEngine",
     "SimClock",
     "poisson_trace",

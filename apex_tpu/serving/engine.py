@@ -17,11 +17,26 @@ step functions, each traced ONCE for the engine's lifetime:
 
 Admitting, retiring, growing or preempting requests between steps
 never changes a device shape, so after :meth:`ServingEngine.warmup`
-the serving lifetime sees ZERO further XLA compilations (the warmup
-compiles the two step functions plus the pool-fill scatter —
-``PagedKVCache.write_tokens`` — three executables total; the
-no-compile steady state is enforced by construction with
-:func:`apex_tpu.analysis.hot_path_guard` in the ISSUE 11 pin).
+the serving lifetime sees ZERO further XLA compilations.  The warmup
+compiles a FIXED, documented executable set (docs/serving.md "The
+compiled-shapes contract"): the two step functions plus the pool-fill
+scatter (``PagedKVCache.write_tokens``), and — with the ISSUE 12
+draft–verify subsystem on — the speculative verify step
+(``q_len = spec.k + 1``) and the ``[1, chunk_size]`` chunked-prefill
+step.  The no-compile steady state is enforced by construction with
+:func:`apex_tpu.analysis.hot_path_guard` (ISSUE 11 pin, extended over
+a speculative + chunked trace in ISSUE 12).
+
+**Speculative decoding (ISSUE 12, docs/serving.md).**  With
+``spec=SpecConfig(k, proposer, chunk_size)`` the decode boundary asks
+a host-side proposer for up to ``k`` draft tokens per request, scores
+all of them in ONE ``flash_decode`` launch at ``q_len = k + 1``
+(:meth:`_verify_batch`), commits the longest prefix the model's own
+greedy argmax endorses plus the bonus token, and rolls rejected rows
+back via plain ``kv_len``/page accounting — exact acceptance keeps
+the bitwise batched==sequential contract intact.  Long prefills split
+into fixed-width chunks (:meth:`_chunk_step`) that interleave with
+decode boundaries under the existing prefill-token budget.
 
 **The isolation contract (and why prefill is one request per row).**
 The acceptance bar for this engine is bitwise: batched continuous
@@ -79,6 +94,8 @@ from apex_tpu.serving.model import (PagedDecoder, ServingModelConfig,
 from apex_tpu.serving.scheduler import (FINISHED, WAITING,
                                         ContinuousBatchingScheduler,
                                         QueueFullError, Request)
+from apex_tpu.serving.spec import (NgramProposer, SpecConfig,
+                                   commit_tokens)
 
 # -- chaos hook (ISSUE 10) ---------------------------------------------------
 # The serving twin of checkpoint.set_fault_hook / data.set_read_hook:
@@ -198,13 +215,36 @@ class ServingEngine:
                  watchdog=None,
                  validate_pages: bool = False,
                  recover_on_fault: bool = True,
-                 max_recoveries: int = 3):
+                 max_recoveries: int = 3,
+                 spec: Optional[SpecConfig] = None):
         self.cfg = cfg
         self.params = params if params is not None else init_params(cfg, seed)
         self.prefill_budget = (cfg.max_position if prefill_budget is None
                                else prefill_budget)
+        # draft–verify subsystem (ISSUE 12, docs/serving.md
+        # "Speculative decoding"): spec.k > 0 adds the verify
+        # executable (q_len = k + 1) and a proposer; spec.chunk_size
+        # adds chunked prefill.  spec=None is the pre-ISSUE-12 engine,
+        # bit-for-bit.
+        self.spec = spec
+        self.spec_k = spec.k if spec is not None else 0
+        self.chunk_size = spec.chunk_size if spec is not None else None
+        self.proposer = None
+        if self.spec_k > 0:
+            self.proposer = (spec.proposer if spec.proposer is not None
+                             else NgramProposer())
         if max_pages_per_request is None:
-            max_pages_per_request = -(-self.prefill_budget // page_size)
+            # a chunked engine serves requests WIDER than the prefill
+            # row (that is the point of chunking), so its page-table
+            # width must default to the max_position ceiling, not the
+            # row width — clamped to the allocatable pool so enabling
+            # chunking never turns a valid construction into a
+            # constructor error (an oversized request still fails
+            # submit() with the pages_needed check, loudly)
+            cap_tokens = (cfg.max_position if self.chunk_size is not None
+                          else self.prefill_budget)
+            max_pages_per_request = min(-(-cap_tokens // page_size),
+                                        max(1, num_pages - 1))
         self.cache = PagedKVCache(
             num_layers=cfg.num_layers, num_pages=num_pages,
             page_size=page_size, num_heads=cfg.num_heads,
@@ -215,7 +255,8 @@ class ServingEngine:
             self.cache, max_batch=max_batch,
             prefill_budget=self.prefill_budget,
             max_position=cfg.max_position,
-            max_queue=max_queue, preempt_cap=preempt_cap)
+            max_queue=max_queue, preempt_cap=preempt_cap,
+            chunk_size=self.chunk_size)
         self.decoder = PagedDecoder(cfg)
         self.max_batch = max_batch
         self.telemetry = telemetry
@@ -245,6 +286,26 @@ class ServingEngine:
                 kv_len)
             return jnp.argmax(logits, axis=-1), k_pool, v_pool
 
+        def _verify(params, k_pool, v_pool, tokens, positions,
+                    write_pages, write_offsets, page_table, kv_len):
+            # all k+1 positions scored in ONE flash_decode launch;
+            # only the argmax ids leave the device
+            logits, k_pool, v_pool = decoder.extend(
+                params, k_pool, v_pool, tokens, positions,
+                write_pages, write_offsets, page_table, kv_len)
+            return jnp.argmax(logits, axis=-1), k_pool, v_pool
+
+        def _chunk(params, k_pool, v_pool, tokens, positions,
+                   write_pages, write_offsets, page_table, kv_len):
+            # one chunk of a long context; front-padding pins the
+            # chunk's last valid token to the final row, so last_only
+            # projects exactly one position through the LM head
+            logits, k_pool, v_pool = decoder.extend(
+                params, k_pool, v_pool, tokens, positions,
+                write_pages, write_offsets, page_table, kv_len,
+                last_only=True)
+            return jnp.argmax(logits[:, 0], axis=-1), k_pool, v_pool
+
         self._prefill_fn = jax.jit(_prefill)
         # donate the pool buffers on TPU: the decode step would
         # otherwise hold old + new pool alive across every step (the
@@ -254,6 +315,10 @@ class ServingEngine:
         # buffers.
         donate = (1, 2) if jax.default_backend() == "tpu" else ()
         self._decode_fn = jax.jit(_decode, donate_argnums=donate)
+        self._verify_fn = (jax.jit(_verify, donate_argnums=donate)
+                           if self.spec_k > 0 else None)
+        self._chunk_fn = (jax.jit(_chunk, donate_argnums=donate)
+                          if self.chunk_size is not None else None)
 
     # -- intake ------------------------------------------------------------
 
@@ -308,14 +373,19 @@ class ServingEngine:
     def warmup(self) -> float:
         """Compile every device executable before any request arrives
         (so TTFT never carries jit-compile wall); returns the seconds
-        spent.  That is THREE executables, not two: the prefill row,
-        the decode step, and the pool scatter that fills an admitted
-        request's pages (``PagedKVCache.write_tokens``) — the scatter
-        was the one warmup originally missed, surfacing as a hidden
-        ~70 ms compile on the FIRST admission's TTFT (caught by the
-        hot_path_guard serving-lifetime pin, ISSUE 11).  The scatter
-        and decode warmups write into scratch page 0, which no reader
-        ever sees."""
+        spent.
+
+        The compiled set is FIXED and documented (docs/serving.md
+        "The compiled-shapes contract"): the prefill row, the decode
+        step, the admission scatter (``PagedKVCache.write_tokens`` —
+        the one warmup originally missed, surfacing as a hidden ~70 ms
+        compile on the first admission's TTFT; caught by the
+        hot_path_guard serving-lifetime pin, ISSUE 11), plus — when
+        the draft–verify subsystem is on (ISSUE 12) — the verify step
+        at ``q_len = spec.k + 1`` and the ``[1, chunk_size]`` chunked-
+        prefill step.  Every warmup launch writes only into scratch
+        page 0, which no reader ever sees; the zero-compiles-after-
+        warmup pin runs a speculative + chunked trace too."""
         t0 = time.perf_counter()
         z = jnp.zeros((1, self.prefill_budget), jnp.int32)
         _, wk0, wv0 = self._prefill_fn(
@@ -332,7 +402,23 @@ class ServingEngine:
             jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
             jnp.zeros((b, p_max), jnp.int32), jnp.ones((b,), jnp.int32))
         self.cache.k, self.cache.v = wk, wv
-        jax.block_until_ready(wk)
+        if self._verify_fn is not None:
+            qw = self.spec_k + 1
+            zq = jnp.zeros((b, qw), jnp.int32)
+            _, wk, wv = self._verify_fn(
+                self.params, self.cache.k, self.cache.v, zq, zq, zq, zq,
+                jnp.zeros((b, p_max), jnp.int32),
+                jnp.full((b,), qw, jnp.int32))
+            self.cache.k, self.cache.v = wk, wv
+        if self._chunk_fn is not None:
+            cs = self.chunk_size
+            zc = jnp.zeros((1, cs), jnp.int32)
+            _, wk, wv = self._chunk_fn(
+                self.params, self.cache.k, self.cache.v, zc, zc, zc, zc,
+                jnp.zeros((1, p_max), jnp.int32),
+                jnp.full((1,), cs, jnp.int32))
+            self.cache.k, self.cache.v = wk, wv
+        jax.block_until_ready(self.cache.k)
         return time.perf_counter() - t0
 
     def _prefill_request(self, req: Request) -> None:
@@ -411,6 +497,138 @@ class ServingEngine:
             req.kv_len = req.seq_len
             req.generated.append(int(next_tok[i]))
 
+    def _verify_batch(self, rows: List[Request],
+                      drafts: Dict[int, List[int]]) -> Tuple[int, int, int]:
+        """One speculative decode boundary: score every row's last
+        committed token + draft in ONE verify launch
+        (``q_len = spec.k + 1``), commit each row's longest matching
+        prefix + bonus token, roll rejected rows back.
+
+        Rows are FRONT-padded to the fixed window (pad rows scatter
+        into scratch and their outputs are discarded), so a row with a
+        ``j``-token draft occupies the last ``j + 1`` query rows and
+        ``kv_len = seq_len + j`` keeps flash_decode's causal alignment
+        exact — a draft-less row (``j = 0``) is literally a plain
+        decode step computed through the verify shape.  Rollback is
+        plain accounting: ``kv_len`` advances only over committed
+        draft rows (stale K/V past it is unreachable and overwritten
+        when the sequence grows back), and surplus tail pages return
+        to the pool via ``free_tail``.  Returns
+        ``(drafted, accepted, committed)`` token counts for the
+        ``decode_step`` telemetry fields."""
+        _fault_point("decode", self.decode_steps)
+        self.cache.verify_pages([req.pages for req in rows])
+        b, qw = self.max_batch, self.spec_k + 1
+        ps = self.cache.page_size
+        tokens = np.zeros((b, qw), np.int32)
+        positions = np.zeros((b, qw), np.int32)
+        wpages = np.zeros((b, qw), np.int32)
+        woffs = np.zeros((b, qw), np.int32)
+        kv_len = np.full((b,), qw, np.int32)  # idle rows: kv_len == q_len
+        row_draft: List[List[int]] = []
+        written: List[int] = []
+        for i, req in enumerate(rows):
+            d = drafts.get(req.rid, [])
+            row_draft.append(d)
+            S, j = req.seq_len, len(d)
+            pad = qw - (j + 1)
+            pos = np.arange(S - 1, S + j)
+            tokens[i, pad:] = [req.generated[-1]] + d
+            positions[i, pad:] = pos
+            pg = np.asarray(req.pages, np.int32)[pos // ps]
+            wpages[i, pad:] = pg
+            woffs[i, pad:] = pos % ps
+            kv_len[i] = S + j
+            written.extend(int(p) for p in pg)
+        page_table = self.cache.page_table(
+            [req.pages for req in rows], rows=b)
+        next_tok, k_pool, v_pool = self._verify_fn(
+            self.params, self.cache.k, self.cache.v,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(wpages), jnp.asarray(woffs), page_table,
+            jnp.asarray(kv_len))
+        self.cache.k, self.cache.v = k_pool, v_pool
+        self.cache.refresh_page_crcs(written)
+        next_tok = np.asarray(next_tok)
+        drafted = accepted = committed = 0
+        for i, req in enumerate(rows):
+            d = row_draft[i]
+            S, j = req.seq_len, len(d)
+            pad = qw - (j + 1)
+            out, n_draft_kv, a = commit_tokens(
+                d, next_tok[i, pad:].tolist(), eos_id=req.eos_id,
+                remaining=req.max_new_tokens - len(req.generated))
+            req.generated.extend(out)
+            req.kv_len = S + n_draft_kv
+            # rollback: pages grown for rejected draft rows go back to
+            # the pool (the next boundary's growth re-takes what the
+            # committed tokens actually need — lowest-first, so the
+            # SAME pages come back, deterministically)
+            keep = self.cache.pages_needed(max(req.seq_len, req.kv_len))
+            self.cache.free_tail(req.pages, keep)
+            drafted += j
+            accepted += a
+            committed += len(out)
+        if self.proposer is not None:
+            self.proposer.observe(drafted, accepted)
+        return drafted, accepted, committed
+
+    def _chunk_step(self, req: Request, start: int, n: int) -> None:
+        """Advance one chunked prefill by ``n <= chunk_size`` tokens:
+        compute K/V for context positions ``[start, start + n)``
+        against the pages earlier chunks already filled, through the
+        fixed ``[1, chunk_size]`` executable (front-padded; pad rows
+        scatter into scratch).  The FINAL chunk's last-position argmax
+        is the request's first sampled token — earlier chunks never
+        pull anything to the host, so a long prefill stays one async
+        dispatch per boundary."""
+        _fault_point("prefill", req.rid)
+        # opt-in CRC read-back, like every other pool-reading step:
+        # this chunk attends over the pages earlier chunks filled — a
+        # corrupted earlier page must raise HERE, before the final
+        # chunk could sample the request's first token from damaged
+        # K/V and commit it into the stream (review-found, pinned;
+        # pages past the filled prefix have no CRC record and are
+        # skipped by verify_pages)
+        self.cache.verify_pages([req.pages])
+        cs = self.chunk_size
+        ps = self.cache.page_size
+        ctx = req.context
+        need = self.cache.pages_needed(start + n)
+        if len(req.pages) < need:
+            raise RuntimeError(
+                f"request {req.rid}: chunk [{start}, {start + n}) found "
+                f"{len(req.pages)} reserved pages, needs {need} — pages "
+                "must be reserved at admission")
+        pad = cs - n
+        tokens = np.zeros((1, cs), np.int32)
+        positions = np.zeros((1, cs), np.int32)
+        wpages = np.zeros((1, cs), np.int32)
+        woffs = np.zeros((1, cs), np.int32)
+        pos = np.arange(start, start + n)
+        tokens[0, pad:] = ctx[start:start + n]
+        positions[0, pad:] = pos
+        pg = np.asarray(req.pages, np.int32)[pos // ps]
+        wpages[0, pad:] = pg
+        woffs[0, pad:] = pos % ps
+        page_table = self.cache.page_table([req.pages], rows=1)
+        next_tok, k_pool, v_pool = self._chunk_fn(
+            self.params, self.cache.k, self.cache.v,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(wpages), jnp.asarray(woffs), page_table,
+            jnp.asarray(np.full((1,), start + n, np.int32)))
+        self.cache.k, self.cache.v = k_pool, v_pool
+        self.cache.refresh_page_crcs(int(p) for p in pg)
+        req.kv_len = start + n
+        req.prefill_pos = start + n
+        if req.prefill_pos >= len(ctx):
+            # prefill complete: sample the first token and leave
+            # chunked mode — the request decodes from the next boundary
+            req.prefill_pos = None
+            req.generated.append(int(np.asarray(next_tok)[0]))
+            if req.first_token_t is None:
+                req.first_token_t = self.clock()
+
     # -- the engine step ---------------------------------------------------
 
     def _emit(self, type_: str, **payload) -> None:
@@ -420,6 +638,8 @@ class ServingEngine:
     def _retire(self, now: float) -> List[Request]:
         done = self.sched.retire_finished(now)
         for req in done:
+            if self.proposer is not None:
+                self.proposer.release(req.rid)
             n = len(req.generated)
             ev = dict(rid=req.rid, reason=req.finish_reason,
                       new_tokens=n, preemptions=req.preemptions)
@@ -445,6 +665,12 @@ class ServingEngine:
         WHERE the request was when its deadline died."""
         shed, timed_out = self.sched.expire_deadlines(
             now, min_service_s=self.shed_min_service_s)
+        if self.proposer is not None:
+            # deadline deaths are retirements too — every terminal
+            # transition must drop per-rid proposer state (the timeout
+            # path leaked the suffix cache; review-found, pinned)
+            for req in shed + timed_out:
+                self.proposer.release(req.rid)
         for req in shed:
             self._emit("request_timeout", rid=req.rid, where="queued",
                        overshoot_ms=round((now - req.deadline_t) * 1e3, 3))
@@ -465,41 +691,90 @@ class ServingEngine:
         with self.watchdog.step(self.steps):
             return self._step_body()
 
+    def _propose_drafts(self) -> Dict[int, List[int]]:
+        """Ask the proposer for each decode-ready row's draft, clamped
+        so the commit can never overshoot ``max_new_tokens`` (which
+        also bounds every written position under ``max_position`` —
+        the submit-time ``prompt + max_new <= max_position`` check
+        makes the clamp transitive).  Empty drafts mean plain decode."""
+        drafts: Dict[int, List[int]] = {}
+        for req in self.sched.running:
+            if req.prefill_pos is not None:
+                continue   # mid-chunk: nothing to decode yet
+            k_eff = min(self.spec_k,
+                        req.max_new_tokens - len(req.generated) - 1)
+            if k_eff <= 0:
+                continue
+            d = self.proposer.propose(req.rid, req.context, k_eff)
+            if d:
+                drafts[req.rid] = [int(t) for t in d[:k_eff]]
+        return drafts
+
     def _step_body(self) -> bool:
         now = self.clock()
         progress = self._expire(now)
         progress = bool(self._retire(now)) or progress
-        admitted = self.sched.admit()
+        if self.chunk_size is not None:
+            chunk_plan, admitted = self.sched.schedule_prefill()
+        else:
+            chunk_plan, admitted = [], self.sched.admit()
         for req in admitted:
             req.admit_t = now
-            ctx_tokens = len(req.context)
-            self._prefill_request(req)
-            self._emit("request_admit", rid=req.rid,
-                       context_tokens=ctx_tokens,
-                       pages=len(req.pages),
-                       preemptions=req.preemptions)
+            ctx_tokens = req.seq_len   # == len(context), O(1)
+            if req.prefill_pos is None:
+                self._prefill_request(req)
+            ev = dict(rid=req.rid, context_tokens=ctx_tokens,
+                      pages=len(req.pages), preemptions=req.preemptions)
+            if req.prefill_pos is not None:
+                ev["chunked"] = True
+            self._emit("request_admit", **ev)
+            progress = True
+        for req, start, n in chunk_plan:
+            self._chunk_step(req, start, n)
             progress = True
         # a request whose budget was a single token is done at prefill
         progress = bool(self._retire(now)) or progress
         evicted: List[Request] = []
+        drafts: Dict[int, List[int]] = {}
         if self.sched.running:
-            evicted = self.sched.ensure_decode_capacity()
-        rows = list(self.sched.running)
+            if self.proposer is not None:
+                drafts = self._propose_drafts()
+            # growth covers each drafted row's verify footprint too
+            # (seq_len + draft); a row preempted while growing simply
+            # drops out of this boundary, draft unused — the proposer
+            # is stateless over committed tokens, so nothing leaks
+            evicted = self.sched.ensure_decode_capacity(
+                extra={rid: len(d) for rid, d in drafts.items()}
+                or None)
+        rows = [r for r in self.sched.running if r.prefill_pos is None]
         if rows:
             t0 = self.clock()
-            self._decode_batch(rows)
+            spec_fields = {}
+            if any(r.rid in drafts for r in rows):
+                drafted, accepted, committed = self._verify_batch(
+                    rows, drafts)
+                new_tokens = committed
+                spec_fields = {"spec_verify": True,
+                               "spec_drafted": drafted,
+                               "spec_accepted": accepted}
+            else:
+                # every draft came back empty (or speculation is off):
+                # the plain q_len=1 decode executable is cheaper
+                self._decode_batch(rows)
+                new_tokens = len(rows)
             self.decode_steps += 1
             # evictions ride the decode_step payload (a preempted
             # request is also visible later: its re-admission's
             # request_admit carries preemptions > 0)
             self._emit("decode_step", batch=len(rows),
-                       new_tokens=len(rows),
+                       new_tokens=new_tokens,
                        pool_used=self.cache.pages_used,
                        pool_pages=self.cache.num_pages - 1,
                        evicted=[r.rid for r in evicted],
-                       step_ms=round((self.clock() - t0) * 1e3, 3))
+                       step_ms=round((self.clock() - t0) * 1e3, 3),
+                       **spec_fields)
             progress = True
-        elif evicted:
+        elif evicted or chunk_plan:
             progress = True
         self.steps += 1
         if isinstance(self.clock, SimClock):
@@ -571,6 +846,19 @@ class ServingEngine:
             req.preemptions = int(r["preemptions"])
             req.admit_t = r["admit_t"]
             req.first_token_t = r["first_token_t"]
+            restored.append(req)
+        # validate BEFORE mutating anything, so a refused restore is
+        # atomic (no half-queued engine, no duplicated retire events
+        # on a retry into a fresh engine): every live request must be
+        # servable by THIS engine's geometry — a chunked engine's
+        # snapshot restored into a chunk-less one would otherwise
+        # queue a beyond-the-row request admission can never take,
+        # starving the whole FIFO forever (review-found, pinned; the
+        # twin of recover()'s chunk_size-preserving rebuild)
+        for req in restored:
+            if not req.done:
+                self.sched.check_servable(req)
+        for req in restored:
             if req.done:
                 # captured between its last decode and its retirement:
                 # already complete — re-admitting would overshoot
@@ -579,7 +867,6 @@ class ServingEngine:
             else:
                 req.state = WAITING
                 self.sched.waiting.append(req)
-            restored.append(req)
         self._next_rid = max(self._next_rid, int(snap["next_rid"]))
         self.steps = int(snap["steps"])
         self.decode_steps = int(snap["decode_steps"])
@@ -595,6 +882,10 @@ class ServingEngine:
             "eos" if req.eos_id is not None and req.generated
             and req.generated[-1] == req.eos_id else "length")
         self.sched.finished.append(req)
+        if self.proposer is not None:
+            # every retirement path must drop per-rid proposer state —
+            # recovery-path retirements leaked the suffix cache
+            self.proposer.release(req.rid)
         self._emit("request_retire", rid=req.rid, reason=req.finish_reason,
                    new_tokens=len(req.generated),
                    preemptions=req.preemptions)
@@ -624,12 +915,21 @@ class ServingEngine:
             prefill_budget=self.prefill_budget,
             max_position=self.cfg.max_position,
             max_queue=self.sched.max_queue,
-            preempt_cap=self.sched.preempt_cap)
+            preempt_cap=self.sched.preempt_cap,
+            # the rebuilt scheduler must keep chunking (ISSUE 12): a
+            # chunk-less rebuild would strand any live request whose
+            # context exceeds the prefill row — schedule_prefill could
+            # never re-admit it, and FIFO admission would starve
+            # everything queued behind it (review-found, pinned)
+            chunk_size=self.chunk_size)
         sched.finished = self.sched.finished   # history survives
         self.sched = sched
         for req in running:
             req.pages = []
             req.kv_len = 0
+            # a mid-chunk request restarts its chunked prefill after
+            # the rebuild — chunk progress is as rebuildable as KV
+            req.prefill_pos = None
             if req.done:
                 # complete-but-unretired at the fault boundary: finish
                 # it here rather than re-prefill past max_new_tokens

@@ -141,6 +141,20 @@ class PagedKVCache:
             self._crc.pop(p, None)
             bisect.insort(self._free, p)
 
+    def free_tail(self, pages: List[int], keep: int) -> None:
+        """Free ``pages[keep:]`` IN PLACE — the speculative-verify
+        rollback (ISSUE 12): pages grown to hold a draft's K/V whose
+        tail rows were rejected are returned to the pool, and the
+        request's page list is truncated to the committed footprint.
+        A ``keep`` at or past the list length is a no-op (a fully
+        accepted draft rolls back nothing)."""
+        if keep < 0:
+            raise ValueError(f"free_tail keep={keep} must be >= 0")
+        tail = pages[keep:]
+        if tail:
+            self.free(tail)
+            del pages[keep:]
+
     def owner_of(self, page: int) -> Optional[int]:
         return self._owner.get(page)
 

@@ -21,6 +21,21 @@ Two entry points mirror the two phases of continuous batching:
   :func:`~apex_tpu.ops.flash_decode` (the r8 decode route).  Batch
   width is fixed at the engine's ``max_batch`` with idle rows masked,
   so this too is one compiled step for the whole serving lifetime.
+* :meth:`PagedDecoder.extend` — the MULTI-TOKEN cache-extension path
+  (ISSUE 12): ``q`` tokens per request through ONE
+  :func:`~apex_tpu.ops.flash_decode` call at ``q_len = q``.  Both
+  halves of the draft–verify subsystem are this method under two
+  fixed shapes: speculative VERIFY (``[max_batch, k + 1]`` — the last
+  committed token plus the draft, all scored in one launch) and
+  CHUNKED PREFILL (``[1, chunk_size]`` — one chunk of a long context
+  against the pages already filled by earlier chunks).  Rows are
+  front-padded so the valid tokens are always the LAST rows of the
+  window — that is what keeps ``flash_decode``'s causal alignment
+  (query row i sees columns ``[0, kv_len - q_len + i]``) exact for
+  partial drafts/chunks without a second mask operand.  K/V write
+  targets are HOST-computed ``(page, offset)`` arrays (the same idiom
+  as ``PagedKVCache.write_tokens``), so padding rows scatter into the
+  scratch page instead of a live slot.
 
 Per-row independence is a hard contract: every op in ``decode`` is
 row-wise (embedding lookup, layer norm, per-row matmuls, paged
@@ -198,4 +213,58 @@ class PagedDecoder:
             x = x + ctx @ layer["wo"]
             x = x + _mlp(_ln(x, layer["ln2"]), layer)
         logits = _ln(x, params["ln_f"]) @ params["embed"].T
+        return logits, k_pool, v_pool
+
+    # -- draft–verify / chunked prefill: multi-token extension -----------
+
+    def extend(self, params, k_pool, v_pool, tokens: jnp.ndarray,
+               positions: jnp.ndarray, write_pages: jnp.ndarray,
+               write_offsets: jnp.ndarray, page_table: jnp.ndarray,
+               kv_len: jnp.ndarray, *, last_only: bool = False):
+        """Append ``q`` tokens per row to the paged cache and score
+        them in one :func:`~apex_tpu.ops.flash_decode` launch.
+
+        ``tokens``/``positions`` ``[b, q]``: each row's newest tokens,
+        FRONT-padded — the valid tokens must be the LAST rows, because
+        flash_decode's causal rule (row i sees columns
+        ``[0, kv_len - q_len + i]``) anchors the query window to the
+        END of the ``kv_len``-token cache.  ``write_pages``/
+        ``write_offsets`` ``[b, q]``: host-computed scatter targets for
+        each row's K/V (padding rows point at scratch page 0, so a
+        partial draft/chunk never dirties a live slot).  ``kv_len``
+        ``[b]``: valid tokens INCLUDING the q-window's real rows — it
+        may be SMALLER than ``q`` (a whole sequence shorter than the
+        fixed window): flash_decode's empty-window rule returns exact
+        zeros for rows whose causal window is empty, and the caller
+        discards pad-row outputs either way (idle rows pass
+        ``kv_len = q``).  ``page_table`` ``[b, p_max]``.
+
+        ``last_only`` (static): project only the final row through the
+        LM head — the chunked-prefill shape, where one next-token
+        distribution is wanted and front-padding pins the chunk's last
+        valid token to row ``q - 1``.  Returns (logits
+        ``[b, q, vocab]`` or ``[b, 1, vocab]``, k_pool', v_pool').
+        """
+        cfg = self.cfg
+        hd, nh = cfg.head_dim, cfg.num_heads
+        b, q = tokens.shape
+        x = params["embed"][tokens] + params["pos"][positions]  # [b, q, h]
+        for li, layer in enumerate(params["layers"]):
+            hdn = _ln(x, layer["ln1"])
+            qkv = hdn @ layer["wqkv"]
+            qh, kh, vh = jnp.split(qkv, 3, axis=-1)
+            k_pool = k_pool.at[li, write_pages, write_offsets].set(
+                kh.reshape(b, q, nh, hd))
+            v_pool = v_pool.at[li, write_pages, write_offsets].set(
+                vh.reshape(b, q, nh, hd))
+            q4 = qh.reshape(b, q, nh, hd).transpose(0, 2, 1, 3)
+            ctx = flash_decode(q4, k_pool[li], v_pool[li],
+                               page_table, kv_len)
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(b, q, -1)
+            x = x + ctx @ layer["wo"]
+            x = x + _mlp(_ln(x, layer["ln2"]), layer)
+        x = _ln(x, params["ln_f"])
+        if last_only:
+            x = x[:, -1:, :]
+        logits = x @ params["embed"].T
         return logits, k_pool, v_pool

@@ -68,7 +68,7 @@ from __future__ import annotations
 
 import dataclasses
 from collections import deque
-from typing import Deque, List, Optional
+from typing import Deque, Dict, List, Optional
 
 from apex_tpu.serving.kv_cache import PagedKVCache, PagePoolExhausted
 
@@ -101,11 +101,25 @@ class Request:
     generated: List[int] = dataclasses.field(default_factory=list)
     pages: List[int] = dataclasses.field(default_factory=list)
     kv_len: int = 0               # tokens whose K/V sit in the pool
+    # chunked-prefill cursor (ISSUE 12): tokens of the admission
+    # context already computed into pages; None = not mid-chunk (the
+    # whole-row path, or prefill complete).  DELIBERATELY not part of
+    # any checkpoint: chunk progress is rebuildable by deterministic
+    # re-prefill, so preemption/restore reset it to start over (the
+    # same contract that keeps KV pages out of engine snapshots).
+    prefill_pos: Optional[int] = None
     preemptions: int = 0
     admit_t: Optional[float] = None
     first_token_t: Optional[float] = None
     finish_t: Optional[float] = None
     finish_reason: Optional[str] = None
+
+    # memoized `context` backing store (not part of the request state:
+    # excluded from repr and from any comparison semantics)
+    _ctx: Optional[List[int]] = dataclasses.field(
+        default=None, repr=False, compare=False)
+    _ctx_key: tuple = dataclasses.field(
+        default=(-1, -1), repr=False, compare=False)
 
     @property
     def deadline_t(self) -> Optional[float]:
@@ -117,8 +131,21 @@ class Request:
     @property
     def context(self) -> List[int]:
         """Tokens whose K/V must be cached at (re-)admission: the
-        prompt plus everything generated before a preemption."""
-        return self.prompt + self.generated
+        prompt plus everything generated before a preemption.
+
+        Memoized on ``(len(prompt), len(generated))``: both lists are
+        append-only for a live request, so the concat is rebuilt only
+        when tokens were committed — a chunked prefill (context frozen
+        across its chunks) and the per-boundary proposer lookup read
+        the SAME list instead of copying O(seq_len) per access
+        (review-found; the hot-path cost was O(C²/chunk) over a long
+        prefill).  Callers must treat the returned list as read-only.
+        """
+        key = (len(self.prompt), len(self.generated))
+        if self._ctx_key != key:
+            self._ctx = self.prompt + self.generated
+            self._ctx_key = key
+        return self._ctx
 
     @property
     def seq_len(self) -> int:
@@ -138,7 +165,12 @@ class ContinuousBatchingScheduler:
     def __init__(self, cache: PagedKVCache, *, max_batch: int,
                  prefill_budget: int, max_position: int,
                  max_queue: Optional[int] = None,
-                 preempt_cap: Optional[int] = 4):
+                 preempt_cap: Optional[int] = 4,
+                 chunk_size: Optional[int] = None):
+        if chunk_size is not None and chunk_size > prefill_budget:
+            raise ValueError(
+                f"chunk_size {chunk_size} exceeds the per-step prefill "
+                f"budget {prefill_budget} — a chunk could never launch")
         self.cache = cache
         self.max_batch = max_batch
         self.prefill_budget = prefill_budget
@@ -147,15 +179,24 @@ class ContinuousBatchingScheduler:
         # on evict-newest preemption (None disables either)
         self.max_queue = max_queue
         self.preempt_cap = preempt_cap
+        # chunked prefill (ISSUE 12): contexts longer than chunk_size
+        # admit into chunked prefill — one fixed-width chunk per
+        # boundary under the shared prefill-token budget — instead of
+        # one whole-row launch (None = every prefill is whole-row)
+        self.chunk_size = chunk_size
         self.waiting: Deque[Request] = deque()
         self.running: List[Request] = []   # admission order
         self.finished: List[Request] = []
 
     # -- intake ----------------------------------------------------------
 
-    def submit(self, req: Request) -> None:
-        """Queue a request; rejects up front what could NEVER be
-        served (so capacity failures later are always transient)."""
+    def check_servable(self, req: Request) -> None:
+        """Raise ``ValueError`` if ``req`` could NEVER be served by
+        THIS scheduler's geometry (so capacity failures later are
+        always transient).  Shared by :meth:`submit` and the engine's
+        ``restore`` — a snapshot taken on a differently-configured
+        engine (e.g. chunked → chunk-less) must fail here, loudly,
+        instead of queueing a request admission can never take."""
         worst = len(req.prompt) + req.max_new_tokens
         if worst > self.max_position:
             raise ValueError(
@@ -168,14 +209,23 @@ class ContinuousBatchingScheduler:
                 f"{self.cache.pages_needed(worst)} pages > "
                 f"max_pages_per_request "
                 f"{self.cache.max_pages_per_request}")
-        if worst > self.prefill_budget:
+        if worst > self.prefill_budget and self.chunk_size is None:
             # the PREEMPTION contract needs the whole worst-case
             # context (prompt + everything it may generate) to fit the
             # fixed prefill row width, or an evicted request could
-            # never be re-admitted
+            # never be re-admitted.  A CHUNKED scheduler lifts this
+            # bound (ISSUE 12): any context past chunk_size — original
+            # or regrown by re-admission — prefills through the fixed
+            # [1, chunk_size] executable, so the row width no longer
+            # caps request size (max_position still does, above)
             raise ValueError(
                 f"request {req.rid}: prompt+max_new {worst} exceeds "
                 f"prefill budget {self.prefill_budget}")
+
+    def submit(self, req: Request) -> None:
+        """Queue a request; rejects up front what could NEVER be
+        served (:meth:`check_servable`)."""
+        self.check_servable(req)
         if self.max_queue is not None and len(self.waiting) >= self.max_queue:
             # overload: refuse loudly rather than queue work that will
             # only time out.  Only NEW submissions are bounded —
@@ -194,14 +244,61 @@ class ContinuousBatchingScheduler:
         caps this STEP's total prefill work — see the module
         docstring).  Returns the admitted list (pages allocated, state
         RUNNING); never raises on capacity — a full pool just admits
-        fewer."""
-        admitted: List[Request] = []
+        fewer.  The whole-row-only entry point: a chunked scheduler
+        must go through :meth:`schedule_prefill`, which also plans the
+        in-flight chunk launches this call would silently drop."""
+        if self.chunk_size is not None:
+            raise RuntimeError(
+                "admit() on a chunked scheduler — use schedule_prefill()")
+        _, admitted = self.schedule_prefill()
+        return admitted
+
+    def schedule_prefill(self) -> tuple:
+        """Plan this boundary's prefill work under the shared
+        prefill-token budget; returns ``(chunks, admitted)``.
+
+        ``chunks`` — ``(request, start, n_tokens)`` launches, in
+        execution order: first one chunk for every in-flight chunked
+        request (admission order — a long prefill advances by AT MOST
+        one chunk per boundary, which is the head-of-line-latency
+        point: decode steps interleave between its chunks instead of
+        stalling behind a whole-row launch), then the first chunk of
+        each newly admitted long request.  ``admitted`` — requests
+        admitted this boundary (pages for the FULL context reserved at
+        admission — the ISSUE 10 reserve-at-admit invariant is
+        unchanged; a context at or under ``chunk_size``, or any
+        context when chunking is off, takes the whole-row prefill
+        path and appears only in ``admitted``).
+
+        Budget accounting: an in-flight chunk consumes its token
+        count; a whole-row admission consumes its context length; a
+        chunked admission consumes ``chunk_size`` (its first chunk —
+        the rest of the context is later boundaries' budget, which is
+        exactly how a 2k-token arrival stops monopolizing a boundary).
+        First failure stops each phase (no out-of-order work — the
+        FIFO fairness rule).
+        """
         budget = self.prefill_budget
+        chunks: List[tuple] = []
+        if self.chunk_size is not None:
+            for req in self.running:
+                if req.prefill_pos is None:
+                    continue
+                # seq_len == len(context) during prefill, without
+                # materializing the prompt+generated list per boundary
+                n = min(self.chunk_size, req.seq_len - req.prefill_pos)
+                if n > budget:
+                    break
+                chunks.append((req, req.prefill_pos, n))
+                budget -= n
+        admitted: List[Request] = []
         while self.waiting and \
                 len(self.running) + len(admitted) < self.max_batch:
             req = self.waiting[0]
-            ctx = len(req.context)
-            if ctx > budget:
+            ctx = req.seq_len
+            chunked = self.chunk_size is not None and ctx > self.chunk_size
+            need = self.chunk_size if chunked else ctx
+            if need > budget:
                 break
             try:
                 pages = self.cache.allocate(
@@ -217,10 +314,13 @@ class ContinuousBatchingScheduler:
             self.waiting.popleft()
             req.pages = pages
             req.state = RUNNING
-            budget -= ctx
+            budget -= need
+            if chunked:
+                req.prefill_pos = 0
+                chunks.append((req, 0, min(self.chunk_size, ctx)))
             admitted.append(req)
         self.running.extend(admitted)
-        return admitted
+        return chunks, admitted
 
     # -- growth / preemption ---------------------------------------------
 
@@ -249,23 +349,36 @@ class ContinuousBatchingScheduler:
         self.cache.free(victim.pages)
         victim.pages = []
         victim.kv_len = 0
+        # a mid-chunk victim restarts its chunked prefill on
+        # re-admission — chunk progress is rebuildable, like KV
+        victim.prefill_pos = None
         victim.state = WAITING
         victim.preemptions += 1
         self.waiting.appendleft(victim)
         return victim
 
-    def ensure_decode_capacity(self) -> List[Request]:
+    def ensure_decode_capacity(self, extra: Optional[Dict[int, int]]
+                               = None) -> List[Request]:
         """Give every running request the page its next token needs,
         preempting from the back of the batch when the pool runs dry.
         Returns the requests preempted (possibly including ones that
         had already grown — eviction strictly follows admission
-        order)."""
+        order).
+
+        ``extra`` (ISSUE 12): per-rid additional token headroom this
+        boundary — a speculative verify launch writes its draft's K/V
+        at positions ``seq_len .. seq_len + draft - 1``, so drafted
+        requests grow to ``pages_needed(seq_len + draft)`` here and
+        the engine rolls the rejected tail back afterwards
+        (:meth:`PagedKVCache.free_tail`)."""
         evicted: List[Request] = []
         for req in list(self.running):
             if req not in self.running:
                 continue  # evicted while growing an earlier request
             while req in self.running:
-                need_pages = self.cache.pages_needed(req.seq_len)
+                want = req.seq_len + (extra.get(req.rid, 0)
+                                      if extra else 0)
+                need_pages = self.cache.pages_needed(want)
                 if len(req.pages) >= need_pages:
                     break
                 try:
@@ -323,6 +436,7 @@ class ContinuousBatchingScheduler:
                 self.cache.free(req.pages)
                 req.pages = []
                 req.kv_len = 0
+                req.prefill_pos = None
                 req.state = FINISHED
                 req.finish_t = now
                 req.finish_reason = "timeout"

@@ -1,0 +1,67 @@
+"""Speculative decoding + chunked prefill (ISSUE 12): the draft–verify
+subsystem over the r8 serving engine.
+
+The kernel half always existed — :func:`~apex_tpu.ops.flash_decode`
+passes its parity sweep at ``q_len > 1`` — this package is the policy
+half, split the same way the rest of the repo wraps fast kernels in
+host-side policy:
+
+* :mod:`~apex_tpu.serving.spec.proposer` — pluggable draft sources
+  (:class:`Proposer` protocol; :class:`NgramProposer` is the
+  suffix-cache self-speculative baseline);
+* :mod:`~apex_tpu.serving.spec.verify` — the exact greedy
+  verify-accept rule (:func:`commit_tokens`): longest matching prefix
+  plus the model's bonus token, so speculation changes throughput,
+  never the token stream;
+* :class:`SpecConfig` — the engine-facing knob bundle: draft width
+  ``k`` (the verify launch is ONE compiled executable at
+  ``q_len = k + 1``), the proposer, and the chunked-prefill width
+  (long prefills split into fixed chunks that interleave with decode
+  boundaries instead of monopolizing them).
+
+See docs/serving.md "Speculative decoding" and "Chunked prefill".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from apex_tpu.serving.spec.proposer import (  # noqa: F401
+    NgramProposer,
+    Proposer,
+)
+from apex_tpu.serving.spec.verify import commit_tokens  # noqa: F401
+
+
+@dataclasses.dataclass
+class SpecConfig:
+    """Speculation/chunking knobs for :class:`~apex_tpu.serving.
+    engine.ServingEngine`.
+
+    ``k`` — max draft tokens per request per decode boundary; the
+    verify executable is compiled once at ``q_len = k + 1`` (``k = 0``
+    disables speculation, e.g. a chunked-prefill-only engine).
+    ``proposer`` — any :class:`Proposer`; None builds a default
+    :class:`NgramProposer` (per-engine, so engines never share cache
+    state).  ``chunk_size`` — chunked-prefill width in tokens (None
+    disables chunking; contexts <= chunk_size still take the
+    whole-row prefill path).
+    """
+
+    k: int = 4
+    proposer: Optional[Proposer] = None
+    chunk_size: Optional[int] = None
+
+    def __post_init__(self):
+        if self.k < 0:
+            raise ValueError("SpecConfig.k must be >= 0")
+        if self.chunk_size is not None and self.chunk_size < 1:
+            raise ValueError("SpecConfig.chunk_size must be >= 1")
+        if self.k == 0 and self.chunk_size is None:
+            raise ValueError(
+                "SpecConfig with k=0 and no chunk_size enables nothing "
+                "— pass spec=None instead")
+
+
+__all__ = ["SpecConfig", "Proposer", "NgramProposer", "commit_tokens"]

@@ -1,0 +1,156 @@
+"""Draft-token proposers: the policy half of speculative decoding.
+
+A proposer guesses the next ``k`` tokens of a request's greedy stream
+so the engine can score all of them in ONE verify launch
+(:func:`~apex_tpu.ops.flash_decode` at ``q_len = k + 1``) instead of
+one decode step per token.  Being a *guess* is the whole contract: the
+verify-accept step (:mod:`apex_tpu.serving.spec.verify`) keeps exactly
+the longest prefix the model itself would have produced, so a bad
+proposer costs throughput, never correctness — and an EMPTY draft is
+always legal (the engine falls back to plain decode).
+
+:class:`NgramProposer` is the self-speculative baseline (no draft
+model, no device work): a per-request suffix cache maps recent n-grams
+of the request's own token history to where they last occurred, and
+the draft is the continuation that followed — greedy decoding is
+highly repetitive (loops, boilerplate, copied spans), which is exactly
+the regime where "what followed this phrase last time" is a strong
+guess.  Lookup is O(ngram_n) dict probes per boundary; indexing is
+incremental (each committed token is indexed once), which is what
+keeps :meth:`NgramProposer.propose` on the engine's hot path
+(``HOT_PATH_FUNCTIONS``) safe.
+
+The :class:`Proposer` protocol deliberately leaves room for a small
+draft *model* later: ``propose`` sees only host-side token history and
+returns host-side ints, so a device-backed proposer slots in without
+touching the verify step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Protocol, Sequence, Tuple, runtime_checkable
+
+
+@runtime_checkable
+class Proposer(Protocol):
+    """What the engine needs from a draft source.
+
+    ``propose(rid, context, k)`` returns up to ``k`` draft tokens for
+    the request whose committed history (prompt + generated) is
+    ``context`` — an empty list means "no guess", and the engine runs
+    a plain decode step for that request.  ``context`` is append-only
+    for a live rid (preemption keeps tokens; only retirement ends a
+    history), which is what makes incremental caching sound.
+
+    ``observe(drafted, accepted)`` is the per-boundary feedback signal
+    (aggregate counts, post-verify); ``release(rid)`` drops any
+    per-request state at retirement.
+    """
+
+    def propose(self, rid: int, context: Sequence[int],
+                k: int) -> List[int]: ...
+
+    def observe(self, drafted: int, accepted: int) -> None: ...
+
+    def release(self, rid: int) -> None: ...
+
+
+class NgramProposer:
+    """Suffix-cache self-speculative proposer.
+
+    Per request, every n-gram (n = ``ngram_n`` down to 1) of the
+    committed token history is indexed to the position RIGHT AFTER its
+    most recent occurrence; ``propose`` looks up the current suffix,
+    longest n first, and drafts the continuation that followed it.  A
+    continuation that runs off the end of history keeps reading from
+    the draft itself (self-referential unrolling), so a period-p cycle
+    proposes the full ``k`` tokens, not just the p that exist verbatim.
+
+    Deterministic by construction — latest occurrence wins, no
+    randomness — so a seeded trace served through a spec engine
+    replays bit-identically (``seed`` is accepted for protocol
+    uniformity with future sampled proposers and recorded, unused).
+    The cache is derived purely from the request's committed tokens:
+    after a preemption (tokens kept) it is still valid, and after an
+    engine ``restore``/``recover`` a fresh proposer rebuilds it from
+    the context on first use — draft state never needs checkpointing.
+    """
+
+    def __init__(self, ngram_n: int = 3, seed: int = 0):
+        if ngram_n < 1:
+            raise ValueError("ngram_n must be >= 1")
+        self.ngram_n = int(ngram_n)
+        self.seed = int(seed)
+        # rid -> {ngram tuple: continuation start}, and how many tokens
+        # of the rid's history have been indexed (grams ending at the
+        # final token are indexed on the NEXT call, once a continuation
+        # exists to point at)
+        self._index: Dict[int, Dict[Tuple[int, ...], int]] = {}
+        self._indexed: Dict[int, int] = {}
+        self._tail: Dict[int, int] = {}   # last indexed token, per rid
+        self.drafted = 0
+        self.accepted = 0
+
+    def _reindex(self, rid: int, context: Sequence[int]) -> Dict:
+        idx = self._index.setdefault(rid, {})
+        done = self._indexed.get(rid, 0)
+        # a rid reused with a DIFFERENT history (fresh engine, same
+        # proposer) breaks the append-only invariant — stale grams
+        # would propose phantom tokens, or point past the new end and
+        # crash the self-referential unroll.  An incremental cursor
+        # always sits at most at len-1, so done >= len means the
+        # history shrank; the tail-token probe catches same-or-longer
+        # replacements (review-found off-by-one: done == len slipped
+        # the old `>` check — pinned)
+        if done and (done >= len(context)
+                     or context[done - 1] != self._tail.get(rid)):
+            idx.clear()
+            done = 0
+        # index grams ENDING at t for t in [done, len-1): continuation
+        # = t + 1 must exist, or the lookup would match the suffix
+        # itself and propose nothing
+        for t in range(done, len(context) - 1):
+            for n in range(1, self.ngram_n + 1):
+                if t + 1 >= n:
+                    idx[tuple(context[t + 1 - n:t + 1])] = t + 1
+        done = max(done, len(context) - 1)
+        self._indexed[rid] = done
+        if done:
+            self._tail[rid] = int(context[done - 1])
+        return idx
+
+    def propose(self, rid: int, context: Sequence[int],
+                k: int) -> List[int]:
+        if k <= 0 or len(context) < 2:
+            return []
+        idx = self._reindex(rid, context)
+        L = len(context)
+        for n in range(min(self.ngram_n, L - 1), 0, -1):
+            start = idx.get(tuple(context[L - n:L]))
+            if start is None or start >= L:
+                # start >= L can only come from a stale index that
+                # slipped the reuse guard — never draft from it
+                continue
+            out: List[int] = []
+            while len(out) < k:
+                q = start + len(out)
+                # past the end of committed history the draft continues
+                # from itself — q - L always lands inside `out` because
+                # start < L
+                out.append(int(context[q]) if q < L else out[q - L])
+            return out
+        return []
+
+    def observe(self, drafted: int, accepted: int) -> None:
+        self.drafted += int(drafted)
+        self.accepted += int(accepted)
+
+    def release(self, rid: int) -> None:
+        self._index.pop(rid, None)
+        self._indexed.pop(rid, None)
+        self._tail.pop(rid, None)
+
+    @property
+    def acceptance_rate(self) -> float:
+        """Accepted over drafted, lifetime (0.0 before any draft)."""
+        return self.accepted / self.drafted if self.drafted else 0.0

@@ -1,0 +1,80 @@
+"""Verify-accept policy: exact greedy acceptance over one verify launch.
+
+The engine scores a request's ``j``-token draft with ONE
+``flash_decode`` call at ``q_len = k + 1`` (query rows = the last
+committed token plus the draft); the model's greedy argmax at row
+``i`` is the token it would have produced after consuming the draft
+prefix ``d_1..d_i``.  :func:`commit_tokens` turns those argmax rows
+into the committed continuation:
+
+* **longest matching prefix** — accept ``d_1..d_a`` where ``a`` is the
+  largest count with ``d_i == argmax[i-1]`` for every ``i <= a``;
+* **the bonus token** — ``argmax[a]`` is the model's own next token
+  after the accepted prefix (the "+1": even a fully rejected draft
+  commits one real token, so a speculative boundary NEVER produces
+  less than a plain decode step);
+* **exact acceptance ⇒ bitwise streams** — every committed token is
+  either a draft token the model's argmax endorsed or the argmax
+  itself, which is precisely the token-by-token greedy sequence; the
+  proposer can only change HOW MANY tokens commit per boundary, never
+  WHICH tokens (the docs/serving.md contract — and the honesty note:
+  this argument is exclusive to greedy argmax; *sampled* acceptance
+  (Leviathan-style rejection sampling) preserves the distribution, not
+  the realized stream, and would re-scope the bitwise claim);
+* **stream-edge truncation** — the commit stops early at ``eos_id`` or
+  the request's remaining ``max_new_tokens`` budget, exactly where
+  sequential decoding would have stopped.
+
+The function also reports how many DRAFT tokens survived into the
+commit (``n_draft_kv``): their K/V was written by the verify launch
+and stays valid, while rejected rows are rolled back by the caller via
+plain ``kv_len``/page accounting (stale K/V past ``kv_len`` is
+unreachable by the decode mask and overwritten when the sequence grows
+back into those slots).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+
+def commit_tokens(draft: Sequence[int], model_argmax: Sequence[int], *,
+                  eos_id: Optional[int], remaining: int,
+                  ) -> Tuple[List[int], int, int]:
+    """Resolve one verify launch for one request.
+
+    ``draft``: the ``j`` proposed tokens.  ``model_argmax``: ``j + 1``
+    greedy ids for query rows ``[t_last, d_1..d_j]`` (``argmax[i]`` =
+    the model's next token after ``d_1..d_i``).  ``remaining``: tokens
+    the request may still generate (``max_new_tokens`` minus generated
+    so far, >= 1 by the caller's contract — done requests retire
+    before the decode boundary).
+
+    Returns ``(committed, n_draft_kv, n_accepted)``: the tokens to
+    append to the stream, how many of them are draft tokens whose K/V
+    is already in the pool (the caller sets ``kv_len += n_draft_kv``
+    — the bonus token's K/V is appended at the NEXT boundary, same as
+    a plain decode step's), and the raw accepted-prefix length (the
+    proposer-quality signal, pre-truncation).
+    """
+    j = len(draft)
+    if len(model_argmax) != j + 1:
+        raise ValueError(
+            f"verify returned {len(model_argmax)} argmax rows for a "
+            f"{j}-token draft (want {j + 1})")
+    if remaining < 1:
+        raise ValueError("commit_tokens on a request with no budget")
+    a = 0
+    while a < j and int(draft[a]) == int(model_argmax[a]):
+        a += 1
+    committed: List[int] = []
+    for t in list(draft[:a]) + [model_argmax[a]]:
+        committed.append(int(t))
+        if len(committed) >= remaining:
+            break
+        if eos_id is not None and int(t) == eos_id:
+            break
+    # how many APPENDED tokens are draft rows (K/V already in pool):
+    # all of them unless truncation cut before the bonus
+    n_draft_kv = min(len(committed), a)
+    return committed, n_draft_kv, a

@@ -49,7 +49,7 @@ GATED_LOWER = (
 GATED_HIGHER = (
     r"_per_sec$", r"_tflops$", r"_mfu", r"goodput$", r"_speedup",
     r"_gb_s$", r"frac_of_roof$", r"frac_of_dot_floor$", r"_min_ratio$",
-    r"_hit_rate$",
+    r"_hit_rate$", r"_accepted_tokens_per_step$",
 )
 
 

@@ -183,6 +183,9 @@ EVENT_FIELDS: Dict[str, Dict[str, FieldSpec]] = {
         "context_tokens": req(int),
         "pages": req(int),
         "preemptions": req(int),
+        # a REAL bool, present only when the request admitted into
+        # chunked prefill (ISSUE 12) — absent means whole-row
+        "chunked": opt(bool),
     },
     "request_retire": {
         "rid": req(int),
@@ -201,6 +204,15 @@ EVENT_FIELDS: Dict[str, Dict[str, FieldSpec]] = {
         "pool_pages": req(int),
         "evicted": opt(list),
         "step_ms": opt(*NUMBER),
+        # speculative verify boundaries (ISSUE 12): present only when
+        # the step ran the draft–verify executable.  spec_verify is a
+        # REAL bool; spec_drafted/spec_accepted count draft tokens
+        # launched/model-endorsed this step (new_tokens carries the
+        # committed total, so accepted-tokens-per-step falls out of
+        # new_tokens / batch on ANY stream, speculative or not)
+        "spec_verify": opt(bool),
+        "spec_drafted": opt(int),
+        "spec_accepted": opt(int),
     },
     # serving resilience (ISSUE 10): overload rejects, deadline deaths
     # (where = "queued" shed / "running" timeout), crash recovery.

@@ -38,6 +38,8 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     tpot_ms: List[float] = []
     ttft_ms: List[float] = []
     pool_occ: List[float] = []
+    commit_tokens = commit_rows = 0
+    spec_drafted = spec_accepted = 0
     deadline_hits = deadline_total = 0
     queue_sheds = run_timeouts = 0
     phase_ms: Dict[str, List[float]] = {}
@@ -77,6 +79,13 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             if ev.get("pool_pages"):
                 pool_occ.append(ev.get("pool_used", 0)
                                 / ev["pool_pages"])
+            # accepted tokens per step (ISSUE 12): committed tokens
+            # over occupied batch rows — exactly 1.0 for a plain
+            # decode stream, > 1.0 whenever speculation lands
+            commit_tokens += int(ev.get("new_tokens", 0))
+            commit_rows += int(ev.get("batch", 0))
+            spec_drafted += int(ev.get("spec_drafted", 0))
+            spec_accepted += int(ev.get("spec_accepted", 0))
         elif ev.get("type") == "profile":
             for k, v in (ev.get("phase_ms") or {}).items():
                 phase_ms.setdefault(k, []).append(float(v))
@@ -125,6 +134,15 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                                    if sf else None)
         out["serving_pool_peak"] = (round(max(pool_occ), 4)
                                     if pool_occ else None)
+        out["serving_accepted_tokens_per_step"] = (
+            round(commit_tokens / commit_rows, 4) if commit_rows
+            else None)
+        if spec_drafted:
+            # proposer quality (pre-truncation): how much of what it
+            # guessed did the model's own argmax endorse
+            out["serving_spec_drafted"] = spec_drafted
+            out["serving_spec_accept_rate"] = round(
+                spec_accepted / spec_drafted, 4)
         # overload/deadline health (ISSUE 10): sheds = explicit load
         # refusal (bounded-queue rejects + queued deadline sheds);
         # timeouts = in-flight deadline deaths; deadline hit rate =
@@ -212,6 +230,12 @@ def format_summary(s: Dict[str, Any]) -> str:
             parts.append(f"ttft p50 {_ms(s['serving_ttft_p50'])}")
         if s.get("serving_pool_peak") is not None:
             parts.append(f"pool peak {_pct(s['serving_pool_peak'])}")
+        if s.get("serving_accepted_tokens_per_step") is not None:
+            parts.append(
+                f"acc {s['serving_accepted_tokens_per_step']:.2f} tok/step")
+        if s.get("serving_spec_accept_rate") is not None:
+            parts.append(
+                f"spec accept {_pct(s['serving_spec_accept_rate'])}")
         if s.get("serving_sheds") or s.get("serving_timeouts"):
             parts.append(f"shed {s.get('serving_sheds', 0)} "
                          f"timeout {s.get('serving_timeouts', 0)}")
@@ -257,6 +281,9 @@ _DIFF_ROWS = (
     ("steps_per_sec", "steps/s", "{:.3f}"),
     ("data_stalls", "data stalls", "{:d}"),
     ("serving_tpot_p50", "tpot p50 (ms)", "{:.2f}"),
+    # speculation health (ISSUE 12): committed tokens per decode-step
+    # row — the accepted-tokens-per-step headline
+    ("serving_accepted_tokens_per_step", "acc tok/step", "{:.3f}"),
     # overload health (ISSUE 10): did the change move the SLO story?
     ("serving_deadline_hit_rate", "deadline hit", "{:.3f}"),
     # phase-attribution rows (ISSUE 9): did the change move exposed

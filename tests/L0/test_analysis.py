@@ -671,6 +671,34 @@ def test_serving_lifetime_zero_compiles_after_warmup(serving_cfg):
 
 
 @pytest.mark.serving
+def test_spec_serving_lifetime_zero_compiles_after_warmup(serving_cfg):
+    """ISSUE 12: the compiled-shapes contract over the GROWN executable
+    set — warmup also compiles the speculative verify step
+    (q_len = k + 1) and the chunked-prefill step, and a trace that
+    exercises draft–verify boundaries, chunked prefill, AND pool-
+    pressure preemption still compiles NOTHING after warmup."""
+    from apex_tpu.serving.engine import ServingEngine, SimClock
+    from apex_tpu.serving.spec import SpecConfig
+
+    eng = ServingEngine(serving_cfg, num_pages=13, page_size=8,
+                        max_batch=4, clock=SimClock(), seed=0,
+                        max_pages_per_request=6,
+                        spec=SpecConfig(k=3, chunk_size=16))
+    eng.warmup()
+    with hot_path_guard("spec serving lifetime", transfers=None) as g:
+        # a long prompt (chunked prefill), repetitive prompts (drafts
+        # that accept), and enough load on 12 pages to preempt
+        reqs = [eng.submit([1, 2] * 12, max_new_tokens=6),
+                eng.submit([3, 4, 3, 4, 3], max_new_tokens=8),
+                eng.submit(list(range(5, 25)), max_new_tokens=4),
+                eng.submit([7, 8] * 5, max_new_tokens=6)]
+        finished = eng.run()
+    assert len(finished) == 4
+    assert g.recompiles == 0 and g.syncs == []
+    assert eng.proposer.drafted > 0, "trace was meant to speculate"
+
+
+@pytest.mark.serving
 def test_serving_unwarmed_engine_trips_the_guard(serving_cfg):
     """Control: without warmup the first admission compiles inside the
     guarded region — the guard MUST fire (this is also the pin for the

@@ -100,8 +100,9 @@ def _unsharded_reference(cfg, plan, tokens, labels, steps, lr):
     # the single-chip fit plan at the real bf16 compute: params are
     # STORED bf16 in both runs, so the floor is one bf16 ulp at the
     # largest param scale (layernorm weights ≈ 1.0 → ulp 2⁻⁸); two
-    # ulps bound the two steps
-    ("bf16_fit", True, 2 ** -7),
+    # ulps bound the two steps — slow tier (~16s; the fp32 cell keeps
+    # the sharding-machinery parity in tier-1, ISSUE 12 wall trim)
+    pytest.param("bf16_fit", True, 2 ** -7, marks=pytest.mark.slow),
 ])
 def test_zero_step_parity_vs_unsharded(plan_name, compute_bf16, tol,
                                        flagship_bf16_fit):

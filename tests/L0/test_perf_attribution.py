@@ -317,6 +317,11 @@ def test_regress_direction_rules():
     assert key_direction("serving_deadline_hit_rate") == "higher"
     assert key_direction("serving_tpot_p99_overload") == "lower"
     assert key_direction("serving_shed_rate") is None
+    # speculation (ISSUE 12): committed tokens per decode-step row up;
+    # the SLO-reference echoes are config, not measurements
+    assert key_direction("serving_accepted_tokens_per_step") == "higher"
+    assert key_direction("serving_slo_ref_first_token") is None
+    assert key_direction("serving_slo_ref_per_token") is None
     # config echoes and counters are NOT gated
     assert key_direction("gpt1p3b_batch") is None
     assert key_direction("bench_schema") is None
@@ -449,6 +454,42 @@ def test_regress_serving_keys_mandatory_on_committed_pair(capsys):
     # ...and a vanished mandatory key is a failure, not a skip
     assert tele_cli(["regress", a, b, "--max-regress", "75",
                      "--keys", "serving_deadline_hit_rate,gone_key"]) == 1
+
+
+def test_regress_speculation_keys_mandatory_on_committed_r12_pair(capsys):
+    """ISSUE 12 satellite: the speculation headline keys are MANDATORY
+    over the committed r12 pair (A = speculation off, B = draft–verify
+    + chunked prefill on, judged against A's own SLO bar).  The gate
+    proves the acceptance criterion on committed data: accepted tokens
+    per step moved OFF the 1.0 baseline while TTFT did not regress."""
+    a = os.path.join(REPO, "BENCH_r12_serving.json")
+    b = os.path.join(REPO, "BENCH_r12b_serving.json")
+    rc = tele_cli(["regress", a, b, "--max-regress", "25", "--json",
+                   "--keys", "serving_accepted_tokens_per_step,"
+                             "serving_ttft_p50,"
+                             "serving_tpot_p99_overload,"
+                             "serving_deadline_hit_rate,"
+                             "serving_shed_rate"])
+    rec = json.loads(capsys.readouterr().out)
+    assert rc == 0, rec["failures"]
+    by_key = {r["key"]: r for r in rec["rows"]}
+    acc = by_key["serving_accepted_tokens_per_step"]
+    assert acc["direction"] == "higher"
+    assert acc["a"] == 1.0 and acc["b"] > 1.0     # the speculation claim
+    ttft = by_key["serving_ttft_p50"]
+    assert ttft["direction"] == "lower" and ttft["b"] <= ttft["a"]
+    assert by_key["serving_shed_rate"]["gated"] is False
+    # the cpu-toy honesty stamp (ISSUE 12 small fix): the committed
+    # absolute numbers must be self-labelled as CLI fixtures, not the
+    # serving perf trajectory
+    for path in (a, b):
+        with open(path) as f:
+            rec = json.load(f)
+        assert rec["serving_config"]["geometry"] == "cpu-toy", path
+    # ...and a vanished mandatory key is a failure, not a skip
+    assert tele_cli(["regress", a, b, "--max-regress", "25",
+                     "--keys", "serving_accepted_tokens_per_step,"
+                               "gone_key"]) == 1
 
 
 def test_regress_refuses_unparsed_driver_capture(capsys):

@@ -472,6 +472,8 @@ ENTRY %main {
         assert nbytes == pytest.approx((64 * 32 + 32 * 48 + 64 * 48) * 4)
         assert "dot_general" in op_name
 
+    @pytest.mark.slow  # real-XLA compile + cost analysis (~22s); the
+    # analytic join cells above pin the math in tier-1 (ISSUE 12 trim)
     def test_join_on_real_compiled_program(self):
         from apex_tpu.profiling.trace_report import (
             hlo_fusion_flops, join_roofline)

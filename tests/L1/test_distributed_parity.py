@@ -8,7 +8,10 @@ from tests.L1.common.harness import RunConfig, compare_trajectories, run_traject
 
 
 @pytest.mark.parametrize("opt_level,rtol", [
-    ("O0", 2e-3),
+    # both 8-device parity cells now ride the slow tier (~12s each;
+    # ISSUE 12 wall trim) — tier-1 keeps the dp8 machinery covered via
+    # the flagship ZeRO parity cell and the L0 tensor-parallel tier
+    pytest.param("O0", 2e-3, marks=pytest.mark.slow),
     # the O2 cell repeats the same 8-device parity at the slower mixed-
     # precision build — held for the slow tier (ISSUE 2 CI satellite)
     pytest.param("O2", 3e-2, marks=pytest.mark.slow),
