@@ -29,11 +29,26 @@ Two halves:
   ``jax.transfer_guard`` with the PR 4 recompile listener (plus a
   CPU-effective host-fetch tripwire) to fail a test on any unexpected
   host transfer or recompile inside a guarded region.  It is what
-  *enforces by construction* the serving engine's two-compiled-shapes
+  *enforces by construction* the serving engine's compiled-shapes
   contract and the flagship step's steady-state no-sync property.
 
+- **compiled artifacts** (ISSUE 13) — :mod:`~apex_tpu.analysis.hlo`
+  parses each registered executable's optimized HLO into an
+  :class:`~apex_tpu.analysis.hlo.ExecutableReport` (verified
+  input→output donation, per-opcode collective inventory with bytes,
+  host-interaction ops, temp/arg/output bytes) and diffs it against
+  the committed ``hlo_contracts.json``::
+
+      python -m apex_tpu.analysis hlo [--update] [--only NAME] [--json]
+
+  Exit 0 = clean, 1 = violations or stale contract entries, 2 =
+  missing/unparseable contract or unbuildable artifact.  The
+  executable registry is :mod:`~apex_tpu.analysis.registry` (imported
+  lazily — it pulls in jax + the serving/flagship stacks).
+
 See docs/analysis.md for the rule catalog (with the incident each
-rule encodes), suppression/baseline syntax, and CI wiring.
+rule encodes), suppression/baseline syntax, the contract schema, and
+CI wiring.
 """
 
 from apex_tpu.analysis.framework import (  # noqa: F401
@@ -46,6 +61,18 @@ from apex_tpu.analysis.framework import (  # noqa: F401
     lint_source,
     normalize_path,
 )
+from apex_tpu.analysis.hlo import (  # noqa: F401
+    CheckResult,
+    ContractFileError,
+    ExecutableReport,
+    check_contract,
+    check_reports,
+    collective_inventory,
+    executable_report,
+    host_interaction_ops,
+    load_contracts,
+    parse_aliases,
+)
 from apex_tpu.analysis.rules import RULES  # noqa: F401
 from apex_tpu.analysis.runtime import (  # noqa: F401
     GuardReport,
@@ -55,15 +82,25 @@ from apex_tpu.analysis.runtime import (  # noqa: F401
 
 __all__ = [
     "Baseline",
+    "CheckResult",
+    "ContractFileError",
+    "ExecutableReport",
     "Finding",
     "GuardReport",
     "HotPathViolation",
     "LintResult",
     "RULES",
     "Rule",
+    "check_contract",
+    "check_reports",
+    "collective_inventory",
     "default_rules",
+    "executable_report",
     "hot_path_guard",
+    "host_interaction_ops",
     "lint_paths",
     "lint_source",
+    "load_contracts",
     "normalize_path",
+    "parse_aliases",
 ]

@@ -1,9 +1,21 @@
-"""CLI: ``python -m apex_tpu.analysis lint [paths] [--baseline FILE]``.
+"""CLI: ``python -m apex_tpu.analysis lint|hlo …``.
 
-The exit code IS the CI gate: 0 = clean against the baseline, 1 =
-non-baselined findings (or stale baseline entries under ``--strict-
-baseline``), 2 = usage error.  ``--json`` emits a machine-readable
-report for tooling.
+Two exit-code CI gates:
+
+- ``lint [paths] [--baseline FILE]`` — the PR 11 AST linter.  0 =
+  clean against the baseline, 1 = non-baselined findings (or stale
+  baseline entries under ``--strict-baseline``), 2 = usage error.
+- ``hlo [--contracts FILE] [--update] [--only NAME] [--json]`` — the
+  ISSUE 13 compiled-artifact contract checker: compiles every
+  registered executable at cpu-toy geometry and diffs its report
+  against ``hlo_contracts.json``.  0 = clean, 1 = contract violations
+  or stale contract entries (an entry for a deleted executable fails
+  loudly), 2 = missing-or-unparseable contract / unbuildable artifact
+  (the r4 ``parsed:null`` lesson: an unreadable gate must not pass
+  green).  ``--update`` rewrites the contracts from the current
+  artifacts — review the diff before committing.
+
+``--json`` emits a machine-readable report for tooling.
 """
 
 from __future__ import annotations
@@ -17,21 +29,107 @@ from typing import List, Optional
 from apex_tpu.analysis.framework import (Baseline, default_rules,
                                          lint_paths)
 
-#: The committed baseline's conventional home: the repo root (the
+#: The committed ledgers' conventional home: the repo root (the
 #: directory holding the ``apex_tpu`` package).
 DEFAULT_BASELINE = "analysis_baseline.json"
+DEFAULT_CONTRACTS = "hlo_contracts.json"
 
 
 def _package_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _find_default_baseline() -> Optional[str]:
+def _find_default_file(fname: str) -> Optional[str]:
     for root in (os.getcwd(), os.path.dirname(_package_root())):
-        p = os.path.join(root, DEFAULT_BASELINE)
+        p = os.path.join(root, fname)
         if os.path.isfile(p):
             return p
     return None
+
+
+def _find_default_baseline() -> Optional[str]:
+    return _find_default_file(DEFAULT_BASELINE)
+
+
+def _cmd_hlo(args) -> int:
+    """The ``hlo`` subcommand body (exit codes in the module
+    docstring).  Registry/jax imports are deferred so ``lint`` stays
+    AST-speed."""
+    from apex_tpu.analysis import hlo as H
+    from apex_tpu.analysis import registry as R
+
+    try:
+        R.ensure_cpu_toy_platform()
+    except RuntimeError as e:
+        print(f"hlo: {e}", file=sys.stderr)
+        return 2
+    names = R.registered_executables()
+    only = args.only or None
+    if only:
+        unknown = sorted(set(only) - set(names))
+        if unknown:
+            print(f"hlo: unknown executable(s) {', '.join(unknown)}; "
+                  f"registered: {', '.join(names)}", file=sys.stderr)
+            return 2
+    reports, errors = R.build_all_reports(only=only)
+    if errors:
+        for name, err in sorted(errors.items()):
+            print(f"hlo: building {name} failed: {err}", file=sys.stderr)
+        print("hlo: an unbuildable artifact cannot gate green (exit 2)",
+              file=sys.stderr)
+        return 2
+
+    cpath = args.contracts or _find_default_file(DEFAULT_CONTRACTS)
+    if args.update:
+        if cpath is None:
+            cpath = os.path.join(os.path.dirname(_package_root()),
+                                 DEFAULT_CONTRACTS)
+        previous = None
+        if only and os.path.isfile(cpath):
+            try:
+                previous = H.load_contracts(cpath)
+            except H.ContractFileError:
+                previous = None   # rewriting an unreadable file is fine
+        H.save_contracts(cpath, reports, previous=previous)
+        print(f"hlo: wrote {len(reports)} contract(s) to {cpath}")
+        return 0
+
+    if cpath is None:
+        print(f"hlo: no {DEFAULT_CONTRACTS} found (generate one with "
+              "--update)", file=sys.stderr)
+        return 2
+    try:
+        doc = H.load_contracts(cpath)
+    except H.ContractFileError as e:
+        print(f"hlo: {e}", file=sys.stderr)
+        return 2
+
+    result = H.check_reports(reports, doc, registry_names=names)
+    if args.as_json:
+        print(json.dumps({
+            "contracts": cpath,
+            "geometry": doc.get("geometry"),
+            "reports": {n: r.to_json() for n, r in sorted(reports.items())},
+            **result.to_json(),
+        }, indent=2))
+    else:
+        n_viol = 0
+        for name in sorted(reports):
+            for v in result.violations.get(name, []):
+                print(f"{name}: {v}")
+                n_viol += 1
+        for name in result.missing:
+            print(f"{name}: registered executable has no contract entry "
+                  f"in {cpath} (run --update)")
+        for name in result.stale:
+            print(f"{name}: stale contract entry — no such registered "
+                  "executable (delete it, or restore the executable)")
+        print(f"{n_viol} violation(s) over {len(reports)} executable(s) "
+              f"({len(result.missing)} missing contract(s), "
+              f"{len(result.stale)} stale entr"
+              f"{'y' if len(result.stale) == 1 else 'ies'}) "
+              f"[geometry: {doc.get('geometry')}]")
+    return result.exit_code
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -58,7 +156,26 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     sub.add_parser("rules", help="print the rule catalog")
 
+    hlo = sub.add_parser(
+        "hlo", help="compiled-artifact contract checker; exit 1 on "
+                    "violations/stale entries, 2 on a missing or "
+                    "unreadable contract")
+    hlo.add_argument("--contracts", default=None,
+                     help=f"contracts JSON (default: {DEFAULT_CONTRACTS} "
+                          "in cwd or next to the package)")
+    hlo.add_argument("--update", action="store_true",
+                     help="rewrite the contracts from the current "
+                          "artifacts instead of checking")
+    hlo.add_argument("--only", action="append", default=None,
+                     metavar="NAME",
+                     help="check only the named executable(s); "
+                          "repeatable")
+    hlo.add_argument("--json", action="store_true", dest="as_json",
+                     help="machine-readable report on stdout")
+
     args = parser.parse_args(argv)
+    if args.cmd == "hlo":
+        return _cmd_hlo(args)
     if args.cmd == "rules":
         for rule in default_rules():
             print(f"{rule.id}  {rule.title}")

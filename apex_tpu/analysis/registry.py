@@ -1,0 +1,253 @@
+"""Registered hot executables for the compiled-artifact contract
+checker (ISSUE 13).
+
+Each entry is a zero-argument builder returning a ``jax.stages.
+Lowered`` for one production executable at **cpu-toy geometry** —
+small enough to compile on the CPU backend in seconds, shaped exactly
+like the production artifact (same program structure, same donation
+spec, same collective pattern; only the dimension sizes shrink).  The
+``hlo`` CLI subcommand and the tier-1 gate compile every entry and
+diff its :class:`~apex_tpu.analysis.hlo.ExecutableReport` against the
+committed ``hlo_contracts.json``.
+
+The registry (8 entries):
+
+- the serving engine's five compiled shapes (prefill row, decode,
+  admission scatter, speculative verify, chunked prefill) — derived
+  from :data:`apex_tpu.serving.engine.SERVING_EXECUTABLES`, lowered by
+  ``ServingEngine.analysis_executables()`` with the TPU pool donation
+  forced on;
+- the dp×tp flagship train step (mesh ``(2, 2, 1)``) — its per-opcode
+  collective inventory is the measured communication-per-step baseline
+  ROADMAP item 3's overlap-aware-ZeRO work gates against;
+- the ZeRO flat optimizer update (``FlatFusedAdam.jit_step`` — the
+  ``input_output_aliases={1:0, 3:1, 4:2}`` donation story verified at
+  the entry boundary);
+- ``reshard_stack`` (the device twin ``reshard_stack_device``) — pure
+  data movement: zero collectives, zero host interaction.
+
+Builders are deliberately lazy (imports inside) so ``python -m
+apex_tpu.analysis lint`` never pays for serving/flagship imports.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import warnings
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+from apex_tpu.analysis.hlo import ExecutableReport, executable_report
+
+__all__ = [
+    "FLAGSHIP_MESH",
+    "FLAGSHIP_TOY",
+    "SERVING_TOY",
+    "build_all_reports",
+    "build_report",
+    "ensure_cpu_toy_platform",
+    "register",
+    "registered_executables",
+]
+
+# -- the cpu-toy geometry (the contracts file's provenance stamp) ---------
+
+#: Serving model + engine knobs — the test_serving toy config with the
+#: full ISSUE 12 draft–verify subsystem enabled so all five compiled
+#: shapes exist.
+SERVING_TOY = dict(vocab_size=64, hidden_size=32, num_heads=4,
+                   num_layers=2, max_position=96)
+SERVING_ENGINE_TOY = dict(num_pages=24, page_size=16, max_batch=4,
+                          prefill_budget=32)
+SERVING_SPEC_K = 2
+SERVING_CHUNK = 16
+
+#: Flagship: the test_flagship toy GPT on a dp=2 × tp=2 mesh — the
+#: smallest geometry where the ZeRO scatter/gather AND the tp
+#: all-reduces both appear in the artifact.
+FLAGSHIP_TOY = dict(num_layers=2, hidden_size=256, num_attention_heads=2,
+                    vocab_size=256, max_position_embeddings=64)
+FLAGSHIP_MESH = (2, 2, 1)
+FLAGSHIP_BATCH = 4
+
+#: Flat-Adam superblock length (must be a multiple of 8·128).
+FLAT_ADAM_N = 8 * 1024
+
+#: reshard_stack geometry: a (dp=4, tp=2) stack merging into (8,) —
+#: the constant-world-size C-order merge of the PR 6 contract.
+RESHARD_FROM = (4, 2, 1024)
+RESHARD_TO = (8, 1024)
+
+
+_REGISTRY: Dict[str, Callable[[], object]] = {}
+
+
+def register(name: str):
+    """Decorator: register a zero-arg ``() -> jax.stages.Lowered``
+    builder under ``name``."""
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def registered_executables() -> Tuple[str, ...]:
+    """Registry names in registration order — the set the contracts
+    file must cover, and the set its entries are judged stale
+    against."""
+    return tuple(_REGISTRY)
+
+
+def ensure_cpu_toy_platform(min_devices: int = 4) -> None:
+    """Force the cpu-toy platform the contracts are stamped with: CPU
+    backend, >= ``min_devices`` emulated host devices (the flagship
+    entry needs a (2, 2, 1) mesh).  Must run before jax's first
+    backend touch; a no-op under the tier-1 conftest, which sets up
+    the same thing.  Raises RuntimeError when the backend already
+    initialized some other way — the checker must not silently
+    compile contracts at a geometry the committed file wasn't stamped
+    with."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if jax.default_backend() != "cpu" or jax.local_device_count() < min_devices:
+        raise RuntimeError(
+            f"cpu-toy platform unavailable: backend="
+            f"{jax.default_backend()!r} with {jax.local_device_count()} "
+            f"device(s), need cpu with >= {min_devices} (run in a fresh "
+            "process, or set XLA_FLAGS=--xla_force_host_platform_"
+            "device_count=8 before jax initializes)")
+
+
+# -- builders -------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=1)
+def _toy_engine():
+    from apex_tpu.serving.engine import ServingEngine
+    from apex_tpu.serving.model import ServingModelConfig
+    from apex_tpu.serving.spec import SpecConfig
+
+    cfg = ServingModelConfig(**SERVING_TOY)
+    return ServingEngine(
+        cfg, **SERVING_ENGINE_TOY,
+        spec=SpecConfig(k=SERVING_SPEC_K, chunk_size=SERVING_CHUNK))
+
+
+@functools.lru_cache(maxsize=1)
+def _serving_lowered():
+    # one analysis_executables() sweep serves all five serving
+    # builders — per-builder calls would re-trace the whole model
+    # five times per gate run
+    return _toy_engine().analysis_executables()
+
+
+def _serving_builder(exec_name: str):
+    def build():
+        return _serving_lowered()[exec_name]
+    build.__name__ = f"serving_{exec_name}"
+    return build
+
+
+def _register_serving() -> None:
+    # table order from the engine's own contract tuple — the registry
+    # cannot drift from the compiled-shapes contract
+    from apex_tpu.serving.engine import SERVING_EXECUTABLES
+
+    for exec_name in SERVING_EXECUTABLES:
+        _REGISTRY[f"serving_{exec_name}"] = _serving_builder(exec_name)
+
+
+_register_serving()
+
+
+@register("flagship_dp_tp_step")
+def _flagship_dp_tp_step():
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu.transformer.testing.flagship import (
+        build_flagship_train_step, gpt1p3b_config)
+
+    n_dev = 1
+    for d in FLAGSHIP_MESH:
+        n_dev *= d
+    cfg = gpt1p3b_config(**FLAGSHIP_TOY)
+    fs = build_flagship_train_step(
+        cfg, plan="bf16_fit", lr=1e-3, devices=jax.devices()[:n_dev],
+        donate=True, mesh_shape=FLAGSHIP_MESH)
+    tokens = jnp.zeros(
+        (FLAGSHIP_BATCH, cfg.max_position_embeddings), jnp.int32)
+    return fs.step.lower(fs.params, fs.opt_state, tokens, tokens)
+
+
+@register("zero_flat_adam_update")
+def _zero_flat_adam_update():
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu.optimizers.flat import FlatAdamState, FlatFusedAdam
+
+    opt = FlatFusedAdam()
+    buf = jax.ShapeDtypeStruct((FLAT_ADAM_N,), jnp.float32)
+    state = FlatAdamState(step=jax.ShapeDtypeStruct((), jnp.int32),
+                          exp_avg=buf, exp_avg_sq=buf)
+    return opt.jit_step().lower(buf, state, buf)
+
+
+@register("reshard_stack")
+def _reshard_stack():
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu.multi_tensor.flat import reshard_stack_device
+
+    # no donate_argnums: jax pairs a donated input only with a
+    # same-shape output, and a reshard changes shape by definition —
+    # requesting donation here would just be a warning, and aliasing
+    # is deliberately NOT part of this entry's contract (see
+    # reshard_stack_device's docstring)
+    fn = jax.jit(lambda v: reshard_stack_device(v, RESHARD_TO))
+    return fn.lower(jax.ShapeDtypeStruct(RESHARD_FROM, jnp.float32))
+
+
+# -- report construction --------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def build_report(name: str) -> ExecutableReport:
+    """Lower + compile one registered executable and parse its report.
+    Donation is forced on for analysis, so the CPU backend warns it
+    cannot honor it — exactly the situation the checker exists to see
+    through (the lowering still records the alias pairs); that one
+    warning is silenced, nothing else."""
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown executable {name!r}; registered: "
+                       f"{', '.join(_REGISTRY)}")
+    lowered = _REGISTRY[name]()
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        compiled = lowered.compile()
+    return executable_report(name, compiled)
+
+
+def build_all_reports(only: Optional[Sequence[str]] = None
+                      ) -> Tuple[Dict[str, ExecutableReport],
+                                 Dict[str, str]]:
+    """Build every (or the ``only``-selected) registered report.
+    Returns ``(reports, errors)`` — a builder failure lands in
+    ``errors`` instead of aborting the sweep, and the CLI maps any
+    error to exit 2: an artifact the checker cannot build/read must
+    never gate green."""
+    reports: Dict[str, ExecutableReport] = {}
+    errors: Dict[str, str] = {}
+    for name in registered_executables():
+        if only is not None and name not in only:
+            continue
+        try:
+            reports[name] = build_report(name)
+        except Exception as e:  # noqa: BLE001 — mapped to exit 2, never pass
+            errors[name] = f"{type(e).__name__}: {e}"
+    return reports, errors

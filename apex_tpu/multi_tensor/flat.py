@@ -135,6 +135,36 @@ def reshard_stack(val: np.ndarray, n_lead: int, want_shape, *,
     return out.reshape(want_shape)
 
 
+def reshard_stack_device(val, want_shape):
+    """Traceable (jit-able) twin of :func:`reshard_stack`'s
+    non-replicated branch for the grow/equal cases: C-order flatten →
+    zero-pad the schema tail → reshape, entirely on device.  Trims
+    stay host-side — the all-zero-tail validation
+    (:func:`repartition_flat` raises on real state) is a
+    data-dependent host decision a traced function cannot express.
+
+    Registered with the ISSUE 13 contract checker (``reshard_stack``
+    registry entry): a reshard is pure data movement, so its compiled
+    artifact must carry ZERO collectives and ZERO host-interaction
+    ops.  (Entry-level donation is NOT part of that contract: jax
+    pairs a donated input only with a same-shape output, and a reshard
+    changes shape by definition — the checker records that fact rather
+    than pretending the alias exists.)"""
+    want_shape = tuple(int(x) for x in want_shape)
+    new_size = int(np.prod(want_shape, dtype=np.int64))
+    flat = jnp.reshape(jnp.asarray(val), (-1,))
+    if new_size < flat.size:
+        raise ValueError(
+            f"reshard_stack_device only grows or keeps size "
+            f"({flat.size} -> {new_size} shrinks): trims need the "
+            "host-side reshard_stack, whose all-zero-tail check is a "
+            "data-dependent decision")
+    if new_size > flat.size:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((new_size - flat.size,), flat.dtype)])
+    return jnp.reshape(flat, want_shape)
+
+
 def spec_lead_axes(spec, axes) -> list:
     """Leading mesh-axis names of a PartitionSpec: walk entries from dim
     0 while each names exactly one axis in ``axes`` (str, or a 1-tuple);

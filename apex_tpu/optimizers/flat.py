@@ -77,6 +77,16 @@ class FlatFusedAdam:
         z = jnp.zeros_like(flat_params, jnp.float32)
         return FlatAdamState(step=jnp.zeros((), jnp.int32), exp_avg=z, exp_avg_sq=z)
 
+    def jit_step(self, *, donate: bool = True):
+        """Jitted :meth:`step` with ``state`` and ``flat_params``
+        donated — the entry-level twin of the kernel's
+        ``input_output_aliases={1: 0, 3: 1, 4: 2}`` (at flagship scale
+        the old params + both moments ARE the fit margin).  The
+        ISSUE 13 contract checker registers this executable and
+        verifies the aliasing actually survived compilation;
+        ``donate=False`` is its negative control."""
+        return jax.jit(self.step, donate_argnums=(1, 2) if donate else ())
+
     def step(self, flat_grads, state: FlatAdamState, flat_params):
         assert flat_params.ndim == 1 and flat_params.size % (8 * LANE) == 0, (
             "superblock must be 1-D with length a multiple of 1024; pack with "

@@ -29,10 +29,13 @@ from __future__ import annotations
 import collections
 import contextlib
 import dataclasses
+import logging
 import re
 from typing import Any, Callable, Dict, Optional
 
 import jax
+
+log = logging.getLogger("apex_tpu.profiling")
 
 __all__ = [
     "annotate",
@@ -43,6 +46,7 @@ __all__ = [
     "cost_report",
     "cost_report_from_compiled",
     "format_cost_report",
+    "opcode_histogram_from_text",
     "CostReport",
     "OpTime",
     "parse_trace_dir",
@@ -175,21 +179,54 @@ class CostReport:
         }
 
 
-_OP_RE = re.compile(r"^\s*(?:ROOT\s+)?%[\w.\-]+\s*=\s*\S+\s+"
+# the shape group is non-greedy (NOT \S+): a tuple shape like
+# `(f32[8,128]{1,0}, f32[16,128]{1,0})` contains spaces, and a \S+
+# match silently dropped every tuple-shaped instruction (async
+# collective -start rows, send, while, tuple) from the histogram —
+# same instruction grammar as analysis.hlo.parse_instructions
+_OP_RE = re.compile(r"^\s*(?:ROOT\s+)?%[\w.\-]+\s*=\s*.*?\s*"
                     r"([a-z][a-z0-9\-]*)\(")
 
 
-def _opcode_histogram(compiled) -> Dict[str, int]:
+def _hlo_text_or_none(compiled, what: str) -> Optional[str]:
+    """``compiled.as_text()``, degrading to ``None`` ONLY for the
+    documented backend-unsupported cases: ``NotImplementedError`` (a
+    backend/AOT artifact without HLO text) and an XLA runtime error
+    that says so (``UNIMPLEMENTED``/``UNAVAILABLE``).  Anything else
+    re-raises — the broad ``except Exception`` this replaces silently
+    turned real bugs into empty reports (the same incident class the
+    PR 11 EX001 rule encodes, fixed in ``guards.global_grad_norm``).
+    Every degrade is logged: an analysis that quietly reports nothing
+    is indistinguishable from a clean one."""
     try:
-        text = compiled.as_text()
-    except Exception:
-        return {}
+        return compiled.as_text()
+    except NotImplementedError as e:
+        log.warning("%s unavailable: as_text not implemented for this "
+                    "backend (%s) — degrading to empty", what, e)
+        return None
+    except jax.errors.JaxRuntimeError as e:
+        if any(tag in str(e) for tag in ("UNIMPLEMENTED", "UNAVAILABLE")):
+            log.warning("%s unavailable: %s — degrading to empty", what, e)
+            return None
+        raise
+
+
+def opcode_histogram_from_text(text: str) -> Dict[str, int]:
+    """Optimized-HLO opcode → count from a module text dump (the
+    pure-parsing half of :func:`cost_report`'s histogram; the ISSUE 13
+    contract checker shares it so CostReport and ExecutableReport
+    cannot disagree on what counts as an instruction)."""
     hist: Dict[str, int] = collections.Counter()
     for line in text.splitlines():
         m = _OP_RE.match(line)
         if m:
             hist[m.group(1)] += 1
     return dict(hist)
+
+
+def _opcode_histogram(compiled) -> Dict[str, int]:
+    text = _hlo_text_or_none(compiled, "opcode histogram")
+    return opcode_histogram_from_text(text) if text is not None else {}
 
 
 def _custom_call_override_flops(hlo_text: str,
@@ -237,11 +274,13 @@ def cost_report_from_compiled(compiled, *,
     mem = compiled.memory_analysis()
     override = 0.0
     if flop_overrides:
-        try:
-            override = _custom_call_override_flops(compiled.as_text(),
-                                                   flop_overrides)
-        except Exception:
-            override = 0.0
+        # degrade (logged) only when the backend cannot produce HLO
+        # text; a parse error in the override matcher itself must
+        # surface — a silent override=0.0 reinstates the documented
+        # 5×-under-report the overrides exist to fix
+        text = _hlo_text_or_none(compiled, "custom-call flop override")
+        if text is not None:
+            override = _custom_call_override_flops(text, flop_overrides)
     return CostReport(
         flops=float(cost.get("flops", 0.0)) + override,
         bytes_accessed=float(cost.get("bytes accessed", 0.0)),

@@ -1,19 +1,29 @@
 """The serving engine: the device-facing half of continuous batching.
 
 :class:`ServingEngine` turns the :class:`~apex_tpu.serving.scheduler.
-ContinuousBatchingScheduler`'s host-side decisions into two compiled
-step functions, each traced ONCE for the engine's lifetime:
+ContinuousBatchingScheduler`'s host-side decisions into a fixed set of
+five compiled executables (:data:`SERVING_EXECUTABLES`; the last two
+only when :class:`~apex_tpu.serving.spec.SpecConfig` enables them),
+each traced ONCE for the engine's lifetime — the table in
+docs/serving.md "The compiled-shapes contract" is machine-checked
+against this module.  The two workhorses:
 
 * **prefill** — a fixed-width packed row (``[1, prefill_budget]``
   tokens + segment ids + per-segment positions) through
   :meth:`~apex_tpu.serving.model.PagedDecoder.prefill`, returning the
   greedy next-token per position and per-layer K/V, which the engine
-  scatters into the request's freshly allocated pages.
+  scatters into the request's freshly allocated pages (the
+  **admission scatter**, ``PagedKVCache.write_tokens`` — executable
+  #3).
 * **decode** — a fixed-width ``[max_batch]`` step through
   :meth:`~apex_tpu.serving.model.PagedDecoder.decode`: append each
   row's newest token's K/V into its current page, attend over the
   row's page list via :func:`~apex_tpu.ops.flash_decode`, sample
   greedily.  Idle rows are pointed at the scratch page and ignored.
+
+The ISSUE 12 draft–verify subsystem adds the **speculative verify**
+step (``[max_batch, spec.k + 1]``) and the **chunked-prefill** step
+(``[1, spec.chunk_size]``) — executables #4 and #5.
 
 Admitting, retiring, growing or preempting requests between steps
 never changes a device shape, so after :meth:`ServingEngine.warmup`
@@ -96,6 +106,17 @@ from apex_tpu.serving.scheduler import (FINISHED, WAITING,
                                         QueueFullError, Request)
 from apex_tpu.serving.spec import (NgramProposer, SpecConfig,
                                    commit_tokens)
+
+#: The compiled-shapes contract as code, in docs/serving.md table
+#: order: every executable :meth:`ServingEngine.warmup` may build.
+#: The doc-drift test pins the module docstring's "fixed set of five"
+#: and the docs table row count to this tuple, and the ISSUE 13
+#: registry (``apex_tpu.analysis.registry``) derives its serving
+#: entries from it — docstring, docs, and contract checker cannot
+#: disagree on the set.
+SERVING_EXECUTABLES = ("prefill", "decode", "admission_scatter",
+                       "verify", "chunk")
+
 
 # -- chaos hook (ISSUE 10) ---------------------------------------------------
 # The serving twin of checkpoint.set_fault_hook / data.set_read_hook:
@@ -306,6 +327,15 @@ class ServingEngine:
                 last_only=True)
             return jnp.argmax(logits[:, 0], axis=-1), k_pool, v_pool
 
+        # raw step functions + the donation each SHIPS with on TPU,
+        # keyed by compiled-shapes-contract name: the ISSUE 13 checker
+        # (analysis_executables) re-lowers these with the TPU donation
+        # spec forced on, so the committed hlo_contracts.json verifies
+        # the contract the production backend actually runs under
+        self._exec_defs = {"prefill": (_prefill, ()),
+                           "decode": (_decode, (1, 2)),
+                           "verify": (_verify, (1, 2)),
+                           "chunk": (_chunk, (1, 2))}
         self._prefill_fn = jax.jit(_prefill)
         # donate the pool buffers on TPU: the decode step would
         # otherwise hold old + new pool alive across every step (the
@@ -319,6 +349,60 @@ class ServingEngine:
                            if self.spec_k > 0 else None)
         self._chunk_fn = (jax.jit(_chunk, donate_argnums=donate)
                           if self.chunk_size is not None else None)
+
+    # -- compiled-artifact exposure (ISSUE 13) -----------------------------
+
+    def _executable_arg_structs(self) -> Dict[str, Tuple]:
+        """``jax.ShapeDtypeStruct`` argument tuples per enabled
+        executable of the compiled-shapes contract (minus the
+        admission scatter, which :class:`PagedKVCache` owns) — the
+        same shapes :meth:`warmup` launches, pinned against it by the
+        no-drift regression so the analyzed artifacts are the served
+        artifacts."""
+        sds = jax.ShapeDtypeStruct
+        i32 = jnp.int32
+        params = jax.tree_util.tree_map(
+            lambda a: sds(jnp.shape(a), a.dtype), self.params)
+        pool = sds(self.cache.k.shape, self.cache.k.dtype)
+        S, b = self.prefill_budget, self.max_batch
+        p_max = self.cache.max_pages_per_request
+        row = sds((1, S), i32)
+        out = {
+            "prefill": (params, row, row, row, sds((), i32)),
+            "decode": (params, pool, pool, sds((b,), i32), sds((b,), i32),
+                       sds((b, p_max), i32), sds((b,), i32)),
+        }
+        if self._verify_fn is not None:
+            q = sds((b, self.spec_k + 1), i32)
+            out["verify"] = (params, pool, pool, q, q, q, q,
+                             sds((b, p_max), i32), sds((b,), i32))
+        if self._chunk_fn is not None:
+            c = sds((1, self.chunk_size), i32)
+            out["chunk"] = (params, pool, pool, c, c, c, c,
+                            sds((1, p_max), i32), sds((1,), i32))
+        return out
+
+    def analysis_executables(self, *, donate: bool = True) -> Dict[str, Any]:
+        """name → ``jax.stages.Lowered`` for every executable of the
+        compiled-shapes contract this configuration enables, at the
+        engine's exact shapes, with the TPU donation spec FORCED on
+        regardless of backend (``__init__`` gates donation off on CPU
+        only to avoid the backend-unsupported warning; the shipped
+        contract is the TPU one, and that is what the ISSUE 13 checker
+        verifies — pool donation machine-checked end-to-end, the PR 8
+        768 MB lesson made structural).  ``donate=False`` is the
+        checker's own negative control: the donate-stripped artifact
+        must FAIL the committed aliasing contract."""
+        structs = self._executable_arg_structs()
+        lowered: Dict[str, Any] = {}
+        for name, (fn, tpu_donate) in self._exec_defs.items():
+            if name not in structs:
+                continue
+            jitted = jax.jit(fn, donate_argnums=tpu_donate if donate else ())
+            lowered[name] = jitted.lower(*structs[name])
+        lowered["admission_scatter"] = self.cache.analysis_executable(
+            self.prefill_budget, donate=donate)
+        return {n: lowered[n] for n in SERVING_EXECUTABLES if n in lowered}
 
     # -- intake ------------------------------------------------------------
 

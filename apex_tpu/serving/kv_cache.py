@@ -192,6 +192,23 @@ class PagedKVCache:
         if self.crc_pages:
             self.refresh_page_crcs(touched)
 
+    def analysis_executable(self, n_tokens: int, *, donate: bool = True):
+        """``jax.stages.Lowered`` of the :meth:`write_tokens` scatter
+        at an ``n_tokens``-row fill width, with the TPU pool donation
+        forced on regardless of backend — the ISSUE 13 contract
+        checker verifies the donation the shipped engine relies on (an
+        undonated scatter copies BOTH full pools per admission on the
+        TTFT-critical path: the PR 8 768 MB lesson).  ``donate=False``
+        is the checker's negative control."""
+        sds = jax.ShapeDtypeStruct
+        pool = sds(self.k.shape, self.k.dtype)
+        new = sds((self.num_layers, n_tokens, self.num_heads,
+                   self.head_dim), self.k.dtype)
+        idx = sds((n_tokens,), jnp.int32)
+        jitted = jax.jit(_scatter_tokens,
+                         donate_argnums=(0, 1) if donate else ())
+        return jitted.lower(pool, pool, new, new, idx, idx)
+
     # -- per-page CRC validation (ISSUE 10, opt-in) ----------------------
 
     def _page_digest(self, page: int) -> Tuple[int, int]:

@@ -493,3 +493,68 @@ ENTRY %main {
         ops = top_ops_report(f, x, steps=2)
         rows = join_roofline(ops, hlo, roof_tflops=100.0)
         assert all("ms" in r and "est_gflops" in r for r in rows)
+
+
+class TestNarrowedDegrades:
+    """ISSUE 13 satellite: the broad `except Exception` degrades in
+    `_opcode_histogram` / `cost_report_from_compiled` are narrowed to
+    the documented backend-unsupported cases, LOGGED, and anything
+    else surfaces (the `guards.global_grad_norm` incident class the
+    PR 11 EX001 rule encodes)."""
+
+    class _Stub:
+        """Compiled-like stub whose as_text raises a chosen error."""
+
+        def __init__(self, exc):
+            self._exc = exc
+
+        def as_text(self):
+            raise self._exc
+
+        def cost_analysis(self):
+            return {"flops": 7.0, "bytes accessed": 3.0}
+
+        def memory_analysis(self):
+            return None
+
+    def test_histogram_degrades_on_not_implemented_and_logs(self, caplog):
+        import logging
+
+        with caplog.at_level(logging.WARNING, logger="apex_tpu.profiling"):
+            out = profiling._opcode_histogram(
+                self._Stub(NotImplementedError("no HLO text")))
+        assert out == {}
+        assert any("degrading to empty" in r.message
+                   for r in caplog.records)
+
+    def test_histogram_degrades_on_unimplemented_runtime_error(self):
+        err = jax.errors.JaxRuntimeError("UNIMPLEMENTED: as_text")
+        assert profiling._opcode_histogram(self._Stub(err)) == {}
+
+    def test_histogram_propagates_unexpected_errors(self):
+        # the regression: a real bug (here a seeded ValueError) used to
+        # silently become an empty histogram
+        with pytest.raises(ValueError, match="seeded"):
+            profiling._opcode_histogram(self._Stub(ValueError("seeded")))
+        with pytest.raises(jax.errors.JaxRuntimeError, match="INTERNAL"):
+            profiling._opcode_histogram(
+                self._Stub(jax.errors.JaxRuntimeError("INTERNAL: boom")))
+
+    def test_cost_report_override_degrades_logged_not_silently(self, caplog):
+        import logging
+
+        with caplog.at_level(logging.WARNING, logger="apex_tpu.profiling"):
+            rep = profiling.cost_report_from_compiled(
+                self._Stub(NotImplementedError("no HLO text")),
+                flop_overrides={"flash": 1e9})
+        # flops keep the cost-model value; the override contributes 0
+        # and the degrade is VISIBLE in the log, never silent
+        assert rep.flops == 7.0 and rep.override_flops == 0.0
+        assert any("custom-call flop override" in r.message
+                   for r in caplog.records)
+
+    def test_cost_report_override_propagates_unexpected_errors(self):
+        with pytest.raises(ValueError, match="seeded"):
+            profiling.cost_report_from_compiled(
+                self._Stub(ValueError("seeded")),
+                flop_overrides={"flash": 1e9})
