@@ -10,19 +10,26 @@ spec, same collective pattern; only the dimension sizes shrink).  The
 diff its :class:`~apex_tpu.analysis.hlo.ExecutableReport` against the
 committed ``hlo_contracts.json``.
 
-The registry (8 entries):
+The registry (9 entries):
 
 - the serving engine's five compiled shapes (prefill row, decode,
   admission scatter, speculative verify, chunked prefill) — derived
   from :data:`apex_tpu.serving.engine.SERVING_EXECUTABLES`, lowered by
   ``ServingEngine.analysis_executables()`` with the TPU pool donation
   forced on;
-- the dp×tp flagship train step (mesh ``(2, 2, 1)``) — its per-opcode
-  collective inventory is the measured communication-per-step baseline
-  ROADMAP item 3's overlap-aware-ZeRO work gates against;
+- the dp×tp flagship train step (mesh ``(2, 2, 1)``) — since ISSUE 15
+  this is the **bucketed-overlap** ZeRO step at the toy bucket cap
+  :data:`FLAGSHIP_BUCKET_BYTES`: the contract pins the ratcheted
+  inventory (tp activation all-reduces + one reduce-scatter/all-gather
+  pair per bucket; the per-leaf boundary grad all-reduces of the
+  serialized construction are GONE, and the old step's 30-all-reduce
+  inventory now FAILS this entry — the control in
+  tests/L0/test_hlo_contracts.py proves it);
 - the ZeRO flat optimizer update (``FlatFusedAdam.jit_step`` — the
   ``input_output_aliases={1:0, 3:1, 4:2}`` donation story verified at
-  the entry boundary);
+  the entry boundary) plus its bucketed twin
+  (``zero_flat_adam_update_bucketed``: one kernel launch per plan
+  span, donation still end-to-end);
 - ``reshard_stack`` (the device twin ``reshard_stack_device``) — pure
   data movement: zero collectives, zero host interaction.
 
@@ -70,8 +77,21 @@ FLAGSHIP_TOY = dict(num_layers=2, hidden_size=256, num_attention_heads=2,
 FLAGSHIP_MESH = (2, 2, 1)
 FLAGSHIP_BATCH = 4
 
+#: Toy bucket cap for the flagship entry (ISSUE 15): small enough that
+#: the ~1.7M-param toy buffer splits into several buckets, so the
+#: contract really pins the per-bucket reduce-scatter/all-gather
+#: structure (the production default, DEFAULT_BUCKET_BYTES, would be a
+#: single bucket at this geometry).
+FLAGSHIP_BUCKET_BYTES = 1 << 20
+
 #: Flat-Adam superblock length (must be a multiple of 8·128).
 FLAT_ADAM_N = 8 * 1024
+
+#: Span plan for the bucketed flat-Adam entry: three sublane-aligned
+#: spans over the FLAT_ADAM_N buffer (a single leaf cannot be split by
+#: the DDP leaf-cap planner — that IS reference semantics — so the
+#: registry pins a hand-built plan the way a sharded caller would).
+FLAT_ADAM_SPANS = ((0, 2048), (2048, 4096), (4096, FLAT_ADAM_N))
 
 #: reshard_stack geometry: a (dp=4, tp=2) stack merging into (8,) —
 #: the constant-world-size C-order merge of the PR 6 contract.
@@ -165,8 +185,7 @@ def _register_serving() -> None:
 _register_serving()
 
 
-@register("flagship_dp_tp_step")
-def _flagship_dp_tp_step():
+def _flagship_lowered(bucket_bytes):
     import jax
     import jax.numpy as jnp
     from apex_tpu.transformer.testing.flagship import (
@@ -178,10 +197,24 @@ def _flagship_dp_tp_step():
     cfg = gpt1p3b_config(**FLAGSHIP_TOY)
     fs = build_flagship_train_step(
         cfg, plan="bf16_fit", lr=1e-3, devices=jax.devices()[:n_dev],
-        donate=True, mesh_shape=FLAGSHIP_MESH)
+        donate=True, mesh_shape=FLAGSHIP_MESH, bucket_bytes=bucket_bytes)
     tokens = jnp.zeros(
         (FLAGSHIP_BATCH, cfg.max_position_embeddings), jnp.int32)
     return fs.step.lower(fs.params, fs.opt_state, tokens, tokens)
+
+
+@register("flagship_dp_tp_step")
+def _flagship_dp_tp_step():
+    return _flagship_lowered(FLAGSHIP_BUCKET_BYTES)
+
+
+def flagship_serialized_lowered():
+    """The PRE-ISSUE-15 serialized construction (bucket_bytes=None):
+    per-leaf boundary grad all-reduces + one monolithic scatter/gather.
+    Deliberately NOT registered — it has no contract to pass; the
+    tests/L0/test_hlo_contracts.py control compiles it and proves it
+    FAILS the ratcheted ``flagship_dp_tp_step`` entry."""
+    return _flagship_lowered(None)
 
 
 @register("zero_flat_adam_update")
@@ -195,6 +228,22 @@ def _zero_flat_adam_update():
     state = FlatAdamState(step=jax.ShapeDtypeStruct((), jnp.int32),
                           exp_avg=buf, exp_avg_sq=buf)
     return opt.jit_step().lower(buf, state, buf)
+
+
+@register("zero_flat_adam_update_bucketed")
+def _zero_flat_adam_update_bucketed():
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu.multi_tensor.buckets import BucketPlan
+    from apex_tpu.optimizers.flat import FlatAdamState, FlatFusedAdam
+
+    opt = FlatFusedAdam()
+    plan = BucketPlan(spans=FLAT_ADAM_SPANS, shard=FLAT_ADAM_N, world=1,
+                      bucket_bytes=None)
+    buf = jax.ShapeDtypeStruct((FLAT_ADAM_N,), jnp.float32)
+    state = FlatAdamState(step=jax.ShapeDtypeStruct((), jnp.int32),
+                          exp_avg=buf, exp_avg_sq=buf)
+    return opt.jit_step(plan=plan).lower(buf, state, buf)
 
 
 @register("reshard_stack")

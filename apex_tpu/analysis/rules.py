@@ -82,6 +82,13 @@ HOT_PATH_FUNCTIONS: Dict[str, Set[str]] = {
         "run_resilient_training"},
     "apex_tpu/resilience/elastic.py": {"run_elastic_training"},
     "apex_tpu/telemetry/accounting.py": {"step_done", "fetch_scalars"},
+    # ISSUE 15: the bucketed-overlap ZeRO data path — the per-bucket
+    # scatter/update/gather walk and the flagship's fused inner step
+    # run every training step; the planner runs at build time but its
+    # output is closed over in jit, so it must stay host-sync-free too
+    "apex_tpu/multi_tensor/buckets.py": {"plan_buckets"},
+    "apex_tpu/contrib/optimizers/distributed_fused.py": {"step_buckets"},
+    "apex_tpu/transformer/testing/flagship.py": {"_bucketed_zero_inner"},
 }
 
 

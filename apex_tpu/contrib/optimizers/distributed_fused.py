@@ -212,6 +212,101 @@ class DistributedShardedOptimizer:
                 axis=0, tiled=True)
         return unflatten(new_flat_p, schema), new_state
 
+    def step_buckets(self, partial_grads, state: ShardedOptState, params,
+                     schema: FlatSchema, plan):
+        """Bucketed-overlap twin of :meth:`step` (ISSUE 15; reference
+        DistributedFusedAdam's chunked reduce-scatter pipeline,
+        distributed_fused_adam.py:316-362).  Call inside shard_map
+        binding ``axis_name``.
+
+        Two deliberate differences from :meth:`step`:
+
+        * ``partial_grads`` are this device's UNSUMMED local grads —
+          the grad of the device's *local* mean loss w.r.t. the full
+          replicated master, taken inside the region.  The summing
+          happens in the per-bucket reduce-scatter itself, which is
+          the whole point: the per-leaf boundary all-reduces a
+          replicated master grad costs (world × the grad bytes, fully
+          serialized before the optimizer can start) never exist.
+          Under the unreplicated-cotangent convention the mesh-sum of
+          those partials is exactly ``world ×`` the grad of the
+          data-mean loss — the same normalization :meth:`step` sees
+          from ``world`` replicated copies — so ``grad_average``
+          divides the same ``world`` back out (exact for power-of-two
+          worlds; parity vs the serialized step is pinned bitwise in
+          tests/L0/test_bucketed_zero.py).
+        * the monolithic psum_scatter/all_gather pair becomes one
+          reduce-scatter + all-gather per ``plan`` bucket.  A bucket is
+          a span of the per-rank shard (a column block of the
+          ``[world, shard]`` view — multi_tensor/buckets.py layout
+          contract), so rank ``r`` receives exactly its canonical
+          slice of every bucket and the returned state layout is
+          IDENTICAL to :meth:`step`'s for every plan: bucket geometry
+          cannot leak into the checkpoint/reshard contract.
+
+        ``e5m2_allgather`` is not supported here (the delta transport
+        needs the fp32 base resident across the whole gather — exactly
+        the transient bucketing exists to retire); use :meth:`step`.
+        """
+        if self.e5m2_allgather:
+            raise NotImplementedError(
+                "e5m2_allgather is not supported by the bucketed step; "
+                "use step() for the compressed-delta transport")
+        world = jax.lax.psum(1, self.axis_name)
+        rank = jax.lax.axis_index(self.axis_name)
+        # axis sizes are static, so this catches a stale plan (e.g.
+        # cached across an elastic mesh reshape) at trace time instead
+        # of as an opaque XLA shape error inside the gather
+        if plan.world != world or plan.shard != schema.total // world:
+            raise ValueError(
+                f"bucket plan (world={plan.world}, shard={plan.shard}) "
+                f"does not match this axis: world={world}, shard="
+                f"{schema.total // world} — re-plan after a mesh change")
+        # hand-built plans are allowed (the registry builds one):
+        # a permuted/gapped span set would reassemble the concat in
+        # the wrong order with no shape error — refuse at trace time
+        plan.validate()
+        shard = plan.shard
+
+        flat_g, _ = flatten(partial_grads, schema,
+                            dtype=self.scatter_dtype or jnp.float32)
+        flat_dtype = self.gather_dtype or jnp.float32
+        flat_p, _ = flatten(params, schema, dtype=flat_dtype)
+        # the canonical [world, shard] view: column block [:, lo:hi]
+        # flattened rank-major is bucket b's reduce-scatter payload
+        g_view = flat_g.reshape(plan.world, shard)
+
+        new_m, new_v, new_cols = [], [], []
+        for lo, hi in plan.spans:
+            k = hi - lo
+            g_b = jax.lax.psum_scatter(
+                g_view[:, lo:hi].reshape(-1), self.axis_name,
+                tiled=True).astype(jnp.float32)
+            if self.grad_average:
+                g_b = g_b / world
+            p_b = jax.lax.dynamic_slice_in_dim(
+                flat_p, rank * shard + lo, k).astype(jnp.float32)
+            m_b = jax.lax.dynamic_slice_in_dim(state.exp_avg, lo, k)
+            v_b = jax.lax.dynamic_slice_in_dim(state.exp_avg_sq, lo, k)
+            # every bucket updates off the same pre-step counter;
+            # _shard_update increments internally, so each bucket's
+            # bias correction sees the identical step number
+            sub = ShardedOptState(state.step, m_b, v_b)
+            new_p_b, sub = self._shard_update(p_b, g_b, sub, None)
+            new_m.append(sub.exp_avg)
+            new_v.append(sub.exp_avg_sq)
+            gathered = jax.lax.all_gather(
+                new_p_b.astype(flat_dtype), self.axis_name,
+                axis=0, tiled=True)
+            new_cols.append(gathered.reshape(plan.world, k))
+
+        new_state = ShardedOptState(
+            step=state.step + 1,
+            exp_avg=jnp.concatenate(new_m),
+            exp_avg_sq=jnp.concatenate(new_v))
+        new_flat_p = jnp.concatenate(new_cols, axis=1).reshape(-1)
+        return unflatten(new_flat_p, schema), new_state
+
 
 @dataclasses.dataclass(frozen=True)
 class DistributedFusedAdam(DistributedShardedOptimizer):
@@ -258,6 +353,16 @@ class DistributedFusedLAMB(DistributedShardedOptimizer):
 
     max_grad_norm: float = 1.0
     weight_decay: float = 0.01
+
+    def step_buckets(self, partial_grads, state, params, schema, plan):
+        """LAMB's global grad-norm prepass needs the WHOLE grad before
+        any shard can clip — under bucketing that norm would silently
+        become per-bucket (a different optimizer).  Refuse rather than
+        diverge; the bucketed flagship path is Adam's."""
+        raise NotImplementedError(
+            "DistributedFusedLAMB has a global grad-norm prepass that "
+            "a per-bucket pipeline cannot honor; use step(), or "
+            "DistributedFusedAdam for the bucketed path")
 
     def _shard_update(self, p, g, state, flat_g):
         b1, b2 = self.betas

@@ -18,6 +18,11 @@ scaler, grad clipping, and ZeRO sharding — exactly the role amp_C plays in
 the reference.
 """
 
+from apex_tpu.multi_tensor.buckets import (  # noqa: F401
+    DEFAULT_BUCKET_BYTES,
+    BucketPlan,
+    plan_buckets,
+)
 from apex_tpu.multi_tensor.flat import (  # noqa: F401
     FlatSchema,
     flatten,

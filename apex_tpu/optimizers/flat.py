@@ -77,17 +77,35 @@ class FlatFusedAdam:
         z = jnp.zeros_like(flat_params, jnp.float32)
         return FlatAdamState(step=jnp.zeros((), jnp.int32), exp_avg=z, exp_avg_sq=z)
 
-    def jit_step(self, *, donate: bool = True):
+    def jit_step(self, *, donate: bool = True, plan=None):
         """Jitted :meth:`step` with ``state`` and ``flat_params``
         donated — the entry-level twin of the kernel's
         ``input_output_aliases={1: 0, 3: 1, 4: 2}`` (at flagship scale
         the old params + both moments ARE the fit margin).  The
         ISSUE 13 contract checker registers this executable and
         verifies the aliasing actually survived compilation;
-        ``donate=False`` is its negative control."""
-        return jax.jit(self.step, donate_argnums=(1, 2) if donate else ())
+        ``donate=False`` is its negative control.  ``plan`` (a
+        :class:`~apex_tpu.multi_tensor.buckets.BucketPlan`, world=1)
+        selects the bucketed walk — one kernel launch per bucket, the
+        single-device twin of the flagship's per-bucket pipeline,
+        registered separately by the checker
+        (``zero_flat_adam_update_bucketed``)."""
+        step = self.step if plan is None else functools.partial(
+            self.step, plan=plan)
+        return jax.jit(step, donate_argnums=(1, 2) if donate else ())
 
-    def step(self, flat_grads, state: FlatAdamState, flat_params):
+    def step(self, flat_grads, state: FlatAdamState, flat_params, *,
+             plan=None):
+        """One fused Adam step over the superblock.
+
+        ``plan=None`` walks the whole buffer in one ``pallas_call``
+        (one grid).  A :class:`~apex_tpu.multi_tensor.buckets.
+        BucketPlan` with ``world=1`` walks it bucket by bucket — one
+        kernel launch per span, each updating its slice in place
+        (``input_output_aliases``) — the launch structure the
+        bucketed ZeRO step pipelines collectives between.  Results
+        are bitwise identical for every plan: the update is
+        elementwise and every span sees the same scalars."""
         assert flat_params.ndim == 1 and flat_params.size % (8 * LANE) == 0, (
             "superblock must be 1-D with length a multiple of 1024; pack with "
             "apex_tpu.multi_tensor.flatten(tree, total_multiple_of=1024)"
@@ -101,6 +119,48 @@ class FlatFusedAdam:
         scal = jnp.stack([jnp.asarray(self.lr, jnp.float32), c1, c2])
 
         n = flat_params.size
+        if plan is None:
+            spans = ((0, n),)
+        else:
+            if plan.world != 1 or plan.shard != n:
+                raise ValueError(
+                    f"FlatFusedAdam wants a world=1 plan over the whole "
+                    f"buffer (shard={n}); got world={plan.world}, "
+                    f"shard={plan.shard}")
+            # hand-built plans are the documented use case (the
+            # registry's FLAT_ADAM_SPANS) — overlapping/gapped spans
+            # would silently corrupt the concat reassembly
+            plan.validate()
+            if any(lo % (8 * LANE) for lo, _ in plan.spans):
+                raise ValueError(
+                    "FlatFusedAdam bucket spans must start on 8*128 "
+                    "sublane-row boundaries; plan with "
+                    "plan_buckets(..., span_align=8*128)")
+            spans = plan.spans
+
+        p_parts, m_parts, v_parts = [], [], []
+        for lo, hi in spans:
+            p, m, v = self._span_update(
+                scal,
+                jax.lax.dynamic_slice_in_dim(flat_params, lo, hi - lo),
+                jax.lax.dynamic_slice_in_dim(flat_grads, lo, hi - lo),
+                jax.lax.dynamic_slice_in_dim(state.exp_avg, lo, hi - lo),
+                jax.lax.dynamic_slice_in_dim(state.exp_avg_sq, lo,
+                                             hi - lo))
+            p_parts.append(p)
+            m_parts.append(m)
+            v_parts.append(v)
+        if len(spans) == 1:
+            p, m, v = p_parts[0], m_parts[0], v_parts[0]
+        else:
+            p = jnp.concatenate(p_parts)
+            m = jnp.concatenate(m_parts)
+            v = jnp.concatenate(v_parts)
+        return p, FlatAdamState(step=step, exp_avg=m, exp_avg_sq=v)
+
+    def _span_update(self, scal, p_span, g_span, m_span, v_span):
+        """One kernel launch over a contiguous lane-aligned span."""
+        n = p_span.size
         rows = n // LANE
         block_rows = min(self.block_rows, rows)
         # shrink to a divisor of rows (rows is a multiple of 8)
@@ -129,10 +189,10 @@ class FlatFusedAdam:
             interpret=use_interpret(),
         )(
             scal,
-            flat_params.reshape(shape2d).astype(jnp.float32),
-            flat_grads.reshape(shape2d).astype(jnp.float32),
-            state.exp_avg.reshape(shape2d),
-            state.exp_avg_sq.reshape(shape2d),
+            p_span.reshape(shape2d).astype(jnp.float32),
+            g_span.reshape(shape2d).astype(jnp.float32),
+            m_span.reshape(shape2d),
+            v_span.reshape(shape2d),
         )
         p, m, v = (x.reshape(-1) for x in out)
-        return p, FlatAdamState(step=step, exp_avg=m, exp_avg_sq=v)
+        return p, m, v

@@ -43,6 +43,9 @@ from apex_tpu.telemetry.bus import (  # noqa: F401
     install_recompile_listener,
 )
 from apex_tpu.telemetry.recorder import FlightRecorder  # noqa: F401
+from apex_tpu.telemetry.regress import (  # noqa: F401
+    load_multichip_record,
+)
 from apex_tpu.telemetry.sampler import (  # noqa: F401
     JaxProfilerTracer,
     ProfileSampler,

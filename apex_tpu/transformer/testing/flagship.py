@@ -14,7 +14,12 @@ Following ZeRO (Rajbhandari et al., 2020), the train step wires
 moments live once per shard group instead of once per replica.  The
 same step runs unchanged from 1 chip (world=1: the collectives are
 identity and the *dtype plan* does the fitting) to a v5e-16 pod slice
-(world=N: state is N-way sharded as well).
+(world=N: state is N-way sharded as well).  Since ISSUE 15 the
+mesh_shape=(dp, tp, pp) step defaults to the **bucketed-overlap**
+data path — per-bucket reduce-scatter/all-gather over partial grads,
+``step_buckets`` + :func:`apex_tpu.multi_tensor.plan_buckets` — see
+:func:`build_flagship_train_step`'s ``bucket_bytes`` notes and
+docs/performance.md "Overlap-aware ZeRO".
 
 Fit plans — why a 15.75-GiB (16.9e9-byte) chip needs one (1.32 B
 params; bytes in GB, world=1):
@@ -54,6 +59,7 @@ from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.contrib.optimizers import DistributedFusedAdam
+from apex_tpu.multi_tensor.buckets import DEFAULT_BUCKET_BYTES, plan_buckets
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer.testing.standalone_gpt import GPTConfig, GPTModel
 
@@ -179,6 +185,9 @@ class FlagshipSetup(NamedTuple):
     # format-4 save (shard_axes=) wants.
     shardings: Any = None
     mesh_axes: Any = None
+    # the ISSUE 15 bucketed-overlap plan the 3-D step compiled with
+    # (None on the single-axis path and the legacy serialized control)
+    bucket_plan: Any = None
 
 
 def build_flagship_train_step(
@@ -191,6 +200,7 @@ def build_flagship_train_step(
     donate: bool = True,
     seed: int = 0,
     mesh_shape: Optional[Sequence[int]] = None,
+    bucket_bytes: Any = "auto",
 ) -> FlagshipSetup:
     """One flagship construction: model + ZeRO-sharded FusedAdam over
     the "data" axis of a fresh ``parallel_state`` mesh spanning
@@ -212,16 +222,40 @@ def build_flagship_train_step(
     **linearized world** — every (d, p, t) coordinate owns one
     contiguous shard of the master flat buffer, so the opt_state leaves
     are ``[dp, pp, tp, shard]`` stacks with spec
-    ``P("data", "pipeline", "tensor")``.  The grad is taken *through*
-    the ``shard_map`` boundary (``value_and_grad`` of the sharded loss
-    closure), so it arrives as the fully replicated global master grad
-    on every device; the optimizer's mesh-wide ``psum_scatter`` then
-    sums ``world`` identical copies and its ``grad_average`` divides
-    them back out — exact for power-of-two worlds, with no per-axis
-    masking or dp-only averaging.  ``pp`` must be 1 for the *train
-    step* (pipeline schedules stay in the dryrun legs; the checkpoint /
-    reshard machinery handles pp > 1 states).  ``mesh_shape=None``
-    keeps the historical single-axis layout byte-for-byte.
+    ``P("data", "pipeline", "tensor")``.  ``pp`` must be 1 for the
+    *train step* (pipeline schedules stay in ``bench_gpt_3d``'s
+    pipeline segment; the checkpoint / reshard machinery handles
+    pp > 1 states).  ``mesh_shape=None`` keeps the historical
+    single-axis layout byte-for-byte.
+
+    ``bucket_bytes`` (3-D path only, ISSUE 15) selects the gradient
+    data path:
+
+    * ``"auto"`` (default) — the **bucketed-overlap ZeRO step**: the
+      grad of the device-local mean loss is taken *inside* the
+      shard_map region (per-device partial grads, no boundary
+      all-reduces), and the flat buffer moves through one
+      reduce-scatter + all-gather **per bucket**
+      (:func:`apex_tpu.multi_tensor.plan_buckets` at
+      :data:`~apex_tpu.multi_tensor.DEFAULT_BUCKET_BYTES`), so XLA's
+      latency-hiding scheduler interleaves collectives with
+      backward/optimizer compute instead of queueing one
+      buffer-sized transfer per direction behind a wall of per-leaf
+      grad all-reduces.  The mesh-sum of the partials is exactly
+      ``world ×`` the data-mean grad — the same normalization the
+      serialized path sees from ``world`` replicated copies — and
+      the optimizer-state layout is canonical for every plan
+      (buckets are per-rank shard spans; multi_tensor/buckets.py),
+      so checkpoints reshard identically.  Parity vs the serialized
+      control is pinned in tests/L0/test_bucketed_zero.py.
+    * an ``int`` — same step at that bucket cap (a cap at or above
+      the buffer size is the one-bucket edge: the serialized
+      collective tail on the new data path).
+    * ``None`` — the **legacy serialized control**: grads taken
+      through the shard_map boundary (per-leaf all-reduces of the
+      replicated master grad) feeding one monolithic mesh-wide
+      ``psum_scatter``/``all_gather`` — kept as the contract-checker
+      negative control and the pre-r15 construction.
     """
     if isinstance(plan, str):
         plan = FIT_PLANS[plan]
@@ -229,7 +263,12 @@ def build_flagship_train_step(
     if mesh_shape is not None:
         return _build_flagship_train_step_3d(
             cfg, plan=plan, lr=lr, weight_decay=weight_decay, devs=devs,
-            donate=donate, seed=seed, mesh_shape=tuple(mesh_shape))
+            donate=donate, seed=seed, mesh_shape=tuple(mesh_shape),
+            bucket_bytes=bucket_bytes)
+    if bucket_bytes != "auto":
+        raise ValueError(
+            "bucket_bytes applies to the mesh_shape=(dp, tp, pp) step; "
+            "the single-axis path keeps the historical layout")
     parallel_state.destroy_model_parallel()
     mesh = parallel_state.initialize_model_parallel(1, 1, devices=devs)
     n_shards = len(devs)
@@ -304,10 +343,11 @@ def _tp_slice_tables(master, local0):
 
 
 def _build_flagship_train_step_3d(cfg, *, plan, lr, weight_decay, devs,
-                                  donate, seed, mesh_shape):
+                                  donate, seed, mesh_shape,
+                                  bucket_bytes="auto"):
     """The mesh_shape=(dp, tp, pp) body of
     :func:`build_flagship_train_step` (see its docstring for the
-    layout contract)."""
+    layout contract and the ``bucket_bytes`` data-path selector)."""
     dp, tp, pp = (int(x) for x in mesh_shape)
     if pp != 1:
         raise NotImplementedError(
@@ -353,20 +393,83 @@ def _build_flagship_train_step_3d(cfg, *, plan, lr, weight_decay, devs,
     opt_state = jax.tree_util.tree_map(
         lambda a: jnp.broadcast_to(a[None, None, None],
                                    (dp, pp, tp, *a.shape)), state0)
+    spec3 = P(*parallel_state.MESH_AXES)
+    mesh_axes = {parallel_state.DATA_AXIS: dp,
+                 parallel_state.PIPELINE_AXIS: pp,
+                 parallel_state.TENSOR_AXIS: tp}
 
+    if bucket_bytes is not None:
+        # -- the bucketed-overlap ZeRO step (ISSUE 15, the default) ----
+        # The whole step is ONE shard_map region.  The grad of the
+        # device-local mean loss is taken INSIDE it: under the
+        # unreplicated-cotangent convention (check_rep=False transposes
+        # ``psum`` to ``psum``) the per-device partial grads carry a
+        # uniform ×tp from the model's tensor-parallel activation
+        # reductions, so their mesh-sum is tp·dp = world × the
+        # data-mean grad — exactly the normalization the serialized
+        # path sees from ``world`` replicated copies, and
+        # ``grad_average`` divides the same ``world`` back out.  What
+        # this buys: the per-leaf boundary all-reduces of a replicated
+        # master grad never exist (8.2× less all-reduce traffic at the
+        # toy contracts geometry), and the grad sum happens in the
+        # per-bucket reduce-scatters the latency-hiding scheduler can
+        # interleave with backward/optimizer compute.  Collective
+        # inventory + end-to-end donation are machine-checked against
+        # hlo_contracts.json (`python -m apex_tpu.analysis hlo`).
+        bb = DEFAULT_BUCKET_BYTES if bucket_bytes == "auto" \
+            else int(bucket_bytes)
+        bplan = plan_buckets(
+            schema, world, bucket_bytes=bb,
+            itemsize=jnp.dtype(plan.scatter_dtype or jnp.float32).itemsize)
+
+        def _bucketed_zero_inner(mp, state, tokens, labels):
+            state = jax.tree_util.tree_map(lambda a: a[0, 0, 0], state)
+            t_idx = jax.lax.axis_index(parallel_state.TENSOR_AXIS)
+
+            def local_loss(mp):
+                return jnp.mean(model.apply(_slice_tp(mp, t_idx), tokens,
+                                            labels=labels))
+
+            loss, grads = jax.value_and_grad(local_loss)(mp)
+            loss = jax.lax.pmean(loss, parallel_state.DATA_AXIS)
+            new_p, new_state = opt.step_buckets(grads, state, mp, schema,
+                                                bplan)
+            return (new_p,
+                    jax.tree_util.tree_map(
+                        lambda a: a[None, None, None], new_state),
+                    loss)
+
+        sharded = shard_map(
+            _bucketed_zero_inner, mesh=mesh,
+            in_specs=(P(), spec3, P("data"), P("data")),
+            out_specs=(P(), spec3, P()),
+            check_rep=False)
+        step = jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+        return FlagshipSetup(
+            step, master, opt_state, mesh, schema, opt, model, plan,
+            shardings=(P(), spec3), mesh_axes=mesh_axes,
+            bucket_plan=bplan)
+
+    # -- the legacy serialized control (bucket_bytes=None) -------------
     # The grad is taken OUTSIDE the shard_map.  Inside a
     # check_rep=False region jax transposes ``psum`` to ``psum``
     # (the unreplicated-cotangent convention), so differentiating
     # through the model's tensor-parallel reductions *inside* the
     # region scales cotangents by the axis size — loss comes out right
-    # and every grad is ×tp (measured, exactly).  Differentiating
-    # through the shard_map boundary instead uses its true adjoints
-    # end-to-end — the convention tensor_parallel/mappings.py documents
-    # and tests/L0/test_tensor_parallel.py's col→row grad-parity case
+    # and every grad is ×tp (measured, exactly; the bucketed step
+    # above RELIES on that uniform factor).  Differentiating through
+    # the shard_map boundary instead uses its true adjoints end-to-end
+    # — the convention tensor_parallel/mappings.py documents and
+    # tests/L0/test_tensor_parallel.py's col→row grad-parity case
     # pins.  The outer grads arrive replicated (the global master
     # grad), so the opt step needs no data-average: the mesh-wide
     # psum_scatter sums world identical copies and grad_average
-    # divides them back out (exact for power-of-two worlds).
+    # divides them back out (exact for power-of-two worlds).  The
+    # price — per-leaf boundary all-reduces, then one monolithic
+    # scatter/gather pair strictly after the whole backward — is the
+    # serialized inventory the ratcheted hlo contract now REJECTS
+    # (tests/L0/test_hlo_contracts.py keeps this path as the negative
+    # control).
     def inner_fwd(mp, tokens, labels):
         t_idx = jax.lax.axis_index(parallel_state.TENSOR_AXIS)
         loss = jnp.mean(model.apply(_slice_tp(mp, t_idx), tokens,
@@ -384,7 +487,6 @@ def _build_flagship_train_step_3d(cfg, *, plan, lr, weight_decay, devs,
         return new_p, jax.tree_util.tree_map(
             lambda a: a[None, None, None], new_state)
 
-    spec3 = P(*parallel_state.MESH_AXES)
     opt_sharded = shard_map(
         inner_opt, mesh=mesh,
         in_specs=(P(), spec3, P()), out_specs=(P(), spec3),
@@ -398,16 +500,13 @@ def _build_flagship_train_step_3d(cfg, *, plan, lr, weight_decay, devs,
     step = jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
     return FlagshipSetup(
         step, master, opt_state, mesh, schema, opt, model, plan,
-        shardings=(P(), spec3),
-        mesh_axes={parallel_state.DATA_AXIS: dp,
-                   parallel_state.PIPELINE_AXIS: pp,
-                   parallel_state.TENSOR_AXIS: tp})
+        shardings=(P(), spec3), mesh_axes=mesh_axes)
 
 
 def flagship_elastic_build(cfg: GPTConfig, *, plan: str | ZeroFitPlan
                            = "bf16_fit", lr: float = 1e-4,
                            seed: int = 0, donate: bool = False,
-                           on_loss=None):
+                           on_loss=None, bucket_bytes="auto"):
     """``build(devices)`` factory for
     :func:`apex_tpu.resilience.run_elastic_training`: each call stands up
     the ZeRO flagship step on exactly ``devices`` (a fresh mesh whose
@@ -425,10 +524,11 @@ def flagship_elastic_build(cfg: GPTConfig, *, plan: str | ZeroFitPlan
     :func:`build_flagship_train_step`'s ``mesh_shape`` notes)."""
 
     def build(devices, mesh_shape=None):
-        fs = build_flagship_train_step(cfg, plan=plan, lr=lr,
-                                       devices=list(devices), seed=seed,
-                                       donate=donate,
-                                       mesh_shape=mesh_shape)
+        fs = build_flagship_train_step(
+            cfg, plan=plan, lr=lr, devices=list(devices), seed=seed,
+            donate=donate, mesh_shape=mesh_shape,
+            bucket_bytes=bucket_bytes if mesh_shape is not None
+            else "auto")
 
         def step_fn(state, batch):
             p, s = state

@@ -376,6 +376,67 @@ def test_flat_adam_donation_verified_and_strippable():
 
 
 # ---------------------------------------------------------------------------
+# ISSUE 15: the ratcheted bucketed-flagship contract
+# ---------------------------------------------------------------------------
+
+
+def test_serialized_flagship_step_fails_ratcheted_contract():
+    """THE ratchet control (ISSUE 15 satellite): the pre-r15 serialized
+    construction — per-leaf boundary grad all-reduces feeding one
+    monolithic scatter/gather — must FAIL the committed (ratcheted)
+    ``flagship_dp_tp_step`` entry on its all-reduce count, while the
+    shipped bucketed artifact passes the same entry.  The ratchet is a
+    one-way door: the serialized inventory cannot silently come
+    back."""
+    rep = executable_report(
+        "flagship_serialized",
+        R.flagship_serialized_lowered().compile())
+    contract = _committed("flagship_dp_tp_step")
+    # the old inventory really is the committed "before" baseline:
+    # 30 all-reduces, one reduce-scatter, one all-gather (PR 13)
+    assert rep.collectives["all-reduce"]["count"] == 30
+    assert rep.collectives["reduce-scatter"]["count"] == 1
+    assert rep.collectives["all-gather"]["count"] == 1
+    v = check_contract(rep, contract)
+    assert any("all-reduce x30 exceeds" in s for s in v), v
+    # ...and the shipped bucketed step passes the entry it ratcheted
+    ok = R.build_report("flagship_dp_tp_step")
+    assert check_contract(ok, contract) == []
+
+
+def test_ratcheted_flagship_entry_pins_the_bucketed_inventory():
+    """The committed entry proves the tentpole structurally: the
+    all-reduce cap dropped WELL below the serialized 30 (only the
+    model's tp activation collectives remain), the scatter/gather pair
+    became per-bucket (several of each), the all-reduce byte inventory
+    collapsed (the replicated-master-grad transfers are gone), and
+    end-to-end donation survived (params + opt-state leaves all
+    aliased)."""
+    fl = _committed("flagship_dp_tp_step")
+    caps = fl["max_collectives"]
+    assert caps["all-reduce"] < 30, caps
+    assert caps["reduce-scatter"] > 1, caps
+    assert caps["all-gather"] == caps["reduce-scatter"], caps
+    # the grad traffic moved out of all-reduce: remaining AR bytes are
+    # activation-sized, an order of magnitude under the old 7.5 MB
+    assert fl["inventory"]["collective_bytes"]["all-reduce"] < 2_000_000
+    assert len(fl["required_aliases"]) >= 19
+
+
+def test_bucketed_flat_adam_contract_donates_end_to_end():
+    """The new bucketed executable's entry: per-span kernel launches
+    still donate params + both moments at the entry boundary (4 alias
+    pairs — the concat reassembly did not break XLA's aliasing) with
+    zero collectives and zero host interaction."""
+    e = _committed("zero_flat_adam_update_bucketed")
+    assert len(e["required_aliases"]) >= 4
+    assert e["max_collectives"] == {}
+    assert e["allow_host_ops"] == []
+    ok = R.build_report("zero_flat_adam_update_bucketed")
+    assert check_contract(ok, e) == []
+
+
+# ---------------------------------------------------------------------------
 # engine exposure: analysis shapes ARE the served shapes
 # ---------------------------------------------------------------------------
 

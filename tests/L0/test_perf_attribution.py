@@ -492,6 +492,98 @@ def test_regress_speculation_keys_mandatory_on_committed_r12_pair(capsys):
                                "gone_key"]) == 1
 
 
+def test_regress_bucketed_zero_keys_mandatory_on_committed_r15_pair(capsys):
+    """ISSUE 15 satellite: the overlap-aware-ZeRO headline keys are
+    MANDATORY over the committed r15 pair (A = the legacy serialized
+    dp×tp step, B = the bucketed-overlap default; both cpu-toy
+    self-stamped).  The gate proves the acceptance criteria on
+    committed data: the flagship exposed-collective key exists and did
+    not regress, the per-bucket collective wall is gated lower-is-
+    better, and the loss-trajectory goldens are BITWISE equal across
+    the A/B — bucketing restructured the collectives without moving
+    the math."""
+    a = os.path.join(REPO, "BENCH_r15_gpt.json")
+    b = os.path.join(REPO, "BENCH_r15b_gpt.json")
+    rc = tele_cli(["regress", a, b, "--max-regress", "25", "--json",
+                   "--keys", "gpt1p3b_exposed_collective_ms,"
+                             "gpt3d_bucket_collective_ms,"
+                             "gpt3d_loss_first,"
+                             "gpt3d_loss_final,"
+                             "gpt3d_zero_allreduce_bytes"])
+    rec = json.loads(capsys.readouterr().out)
+    assert rc == 0, rec["failures"]
+    by_key = {r["key"]: r for r in rec["rows"]}
+    exp = by_key["gpt1p3b_exposed_collective_ms"]
+    assert exp["direction"] == "lower" and exp["b"] <= exp["a"]
+    assert by_key["gpt3d_bucket_collective_ms"]["direction"] == "lower"
+    # the loss goldens are informational (no direction rule) but must
+    # be BITWISE equal: the parity claim, in record form
+    for k in ("gpt3d_loss_first", "gpt3d_loss_final"):
+        row = by_key[k]
+        assert row["gated"] is False
+        assert row["a"] == row["b"], (k, row)
+    # counters are reported-not-gated; assert the structural claim
+    # directly on the committed records
+    ka, kb = (json.load(open(p)) for p in (a, b))
+    assert ka["gpt3d_bucket_count"] == 0 and kb["gpt3d_bucket_count"] > 1
+    assert ka["gpt3d_zero_allreduce_count"] \
+        > kb["gpt3d_zero_allreduce_count"]
+    assert ka["gpt3d_zero_allreduce_bytes"] \
+        > 10 * kb["gpt3d_zero_allreduce_bytes"]
+    assert ka["gpt3d_zero_reduce_scatter_count"] == 1
+    assert kb["gpt3d_zero_reduce_scatter_count"] \
+        == kb["gpt3d_bucket_count"] == kb["gpt3d_zero_all_gather_count"]
+    # cpu-toy honesty stamp (r12 discipline)
+    for rec_ in (ka, kb):
+        assert rec_["gpt3d_config"]["geometry"] == "cpu-toy"
+    # ...and a vanished mandatory key is a failure, not a skip
+    assert tele_cli(["regress", a, b, "--max-regress", "25",
+                     "--keys", "gpt1p3b_exposed_collective_ms,"
+                               "gone_key"]) == 1
+
+
+def test_bucket_ms_direction_rule():
+    """The *_bucket_*_ms family (ISSUE 15) is gated lower-is-better —
+    by the explicit family rule, not only the generic _ms suffix."""
+    from apex_tpu.telemetry.regress import key_direction
+
+    assert key_direction("gpt3d_bucket_collective_ms") == "lower"
+    assert key_direction("anything_bucket_rs_wall_ms") == "lower"
+    # counters/echoes in the same family stay ungated
+    assert key_direction("gpt3d_bucket_count") is None
+    assert key_direction("gpt3d_bucket_bytes") is None
+
+
+def test_multichip_records_are_geometry_stamped(tmp_path):
+    """ISSUE 15 satellite (the ROADMAP maintenance note's last gap):
+    every committed MULTICHIP_r*.json self-declares its geometry, and
+    the loader refuses an unstamped record."""
+    import glob
+
+    from apex_tpu.telemetry import load_multichip_record
+
+    paths = sorted(glob.glob(os.path.join(REPO, "MULTICHIP_r*.json")))
+    assert len(paths) >= 9  # r01..r08 + r15
+    for p in paths:
+        rec = load_multichip_record(p)
+        assert rec["geometry"], p
+    # the r15 record is the consolidated-leg run, on the emulated mesh
+    r15 = load_multichip_record(os.path.join(REPO, "MULTICHIP_r15.json"))
+    assert r15["ok"] is True and r15["geometry"] == "cpu-toy"
+    assert "legs=[gpt_3d, chaos_mesh, chaos_data, chaos_serving]" \
+        in r15["tail"]
+    # refusal controls: unstamped record, non-record file
+    p = tmp_path / "unstamped.json"
+    p.write_text(json.dumps({"n_devices": 8, "rc": 0, "ok": True,
+                             "tail": ""}))
+    with pytest.raises(ValueError, match="geometry provenance"):
+        load_multichip_record(str(p))
+    q = tmp_path / "notarecord.json"
+    q.write_text(json.dumps({"hello": 1}))
+    with pytest.raises(ValueError, match="not a MULTICHIP"):
+        load_multichip_record(str(q))
+
+
 def test_regress_refuses_unparsed_driver_capture(capsys):
     """The r4 record's parsed:null capture must exit 2 (usage error),
     never green — a gate comparing nothing is no gate."""
