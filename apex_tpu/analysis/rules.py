@@ -78,6 +78,11 @@ HOT_PATH_FUNCTIONS: Dict[str, Set[str]] = {
     # the chunk splitter (per boundary)
     "apex_tpu/serving/spec/proposer.py": {"propose", "_reindex"},
     "apex_tpu/serving/scheduler.py": {"schedule_prefill"},
+    # ISSUE 16: the fleet round — placement, health probing, and the
+    # migration hop all run between engine steps; a host sync or a
+    # device pull here stalls EVERY replica, not one
+    "apex_tpu/serving/fleet/router.py": {
+        "route", "_migrate_requests", "_health_check"},
     "apex_tpu/transformer/testing/train_loop.py": {
         "run_resilient_training"},
     "apex_tpu/resilience/elastic.py": {"run_elastic_training"},

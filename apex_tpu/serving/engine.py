@@ -237,6 +237,7 @@ class ServingEngine:
                  validate_pages: bool = False,
                  recover_on_fault: bool = True,
                  max_recoveries: int = 3,
+                 reject_unservable: bool = False,
                  spec: Optional[SpecConfig] = None):
         self.cfg = cfg
         self.params = params if params is not None else init_params(cfg, seed)
@@ -286,6 +287,11 @@ class ServingEngine:
         self.watchdog = watchdog
         self.recover_on_fault = recover_on_fault
         self.max_recoveries = int(max_recoveries)
+        # ISSUE 16: a router fronting many engines needs permanent
+        # refusal as DATA (terminal `rejected` + request_reject
+        # reason="unservable"), not a ValueError — default off keeps
+        # the single-engine caller-bug contract
+        self.reject_unservable = bool(reject_unservable)
         self.recoveries = 0
         self.rejected: List[Request] = []
         self._next_rid = 0
@@ -437,20 +443,30 @@ class ServingEngine:
 
     def _try_submit(self, req: Request) -> Request:
         """Queue ``req`` or reject it explicitly.  Never-servable
-        requests still raise ``ValueError`` (caller bug); a full
-        bounded queue is an OVERLOAD signal: the request finishes as
-        ``rejected`` with a ``request_reject`` event, and the engine
+        requests raise ``ValueError`` (caller bug) — unless
+        ``reject_unservable`` is set, in which case they finish as
+        ``rejected`` with ``reason="unservable"`` so a fleet router
+        can tell permanent refusal from backpressure.  A full bounded
+        queue is an OVERLOAD signal: the request finishes as
+        ``rejected`` with ``reason="queue_full"``, and the engine
         keeps serving what it already accepted."""
         try:
             self.sched.submit(req)
         except QueueFullError:
-            req.state = FINISHED
-            req.finish_t = self.clock()
-            req.finish_reason = "rejected"
-            self.rejected.append(req)
-            self._emit("request_reject", rid=req.rid, reason="queue_full",
-                       queue_depth=len(self.sched.waiting))
+            self._reject(req, "queue_full")
+        except ValueError:
+            if not self.reject_unservable:
+                raise
+            self._reject(req, "unservable")
         return req
+
+    def _reject(self, req: Request, reason: str) -> None:
+        req.state = FINISHED
+        req.finish_t = self.clock()
+        req.finish_reason = "rejected"
+        self.rejected.append(req)
+        self._emit("request_reject", rid=req.rid, reason=reason,
+                   queue_depth=len(self.sched.waiting))
 
     # -- device steps ------------------------------------------------------
 
@@ -939,9 +955,19 @@ class ServingEngine:
         # queue a beyond-the-row request admission can never take,
         # starving the whole FIFO forever (review-found, pinned; the
         # twin of recover()'s chunk_size-preserving rebuild)
-        for req in restored:
-            if not req.done:
-                self.sched.check_servable(req)
+        live = [req for req in restored if not req.done]
+        for req in live:
+            self.sched.check_servable(req)
+        if self.sched.max_queue is not None and \
+                len(live) > self.sched.max_queue:
+            # capacity mismatch is refused with the same atomicity as
+            # geometry mismatch (ISSUE 16): a migration target that
+            # cannot QUEUE the batch must refuse before mutating, so
+            # the caller can pick another target with the snapshot
+            # intact
+            raise ValueError(
+                f"snapshot holds {len(live)} live requests > "
+                f"max_queue {self.sched.max_queue}")
         for req in restored:
             if req.done:
                 # captured between its last decode and its retirement:
@@ -955,6 +981,60 @@ class ServingEngine:
         self.steps = int(snap["steps"])
         self.decode_steps = int(snap["decode_steps"])
         return restored
+
+    def adopt(self, records: Sequence[Dict[str, Any]]) -> List[Request]:
+        """Admit snapshot-format request records into THIS possibly
+        BUSY engine — the fleet migration path (ISSUE 16).
+        :meth:`restore` refuses a busy target by design; a healthy
+        replica receiving a fenced peer's requests is mid-service, so
+        migration needs an entry point that merges into live state.
+
+        Validation is ATOMIC: every record must be servable by this
+        engine's geometry, must not collide with a live rid, and the
+        whole batch must fit the remaining ``max_queue`` headroom —
+        all checked before any state mutates, so a refused adopt
+        leaves the engine exactly as it was and the caller can try
+        another target.  Live records enter the waiting queue pageless
+        (the deterministic re-prefill path rebuilds their KV, exactly
+        as restore/recover do); already-done records retire
+        immediately.  Returns this engine's new request handles — the
+        source replica's old handles are dead."""
+        adopted: List[Request] = []
+        for r in records:
+            req = Request(
+                rid=int(r["rid"]), prompt=list(r["prompt"]),
+                max_new_tokens=int(r["max_new_tokens"]),
+                eos_id=r["eos_id"], arrival_t=float(r["arrival_t"]),
+                deadline_s=r["deadline_s"])
+            req.generated = list(r["generated"])
+            req.preemptions = int(r["preemptions"])
+            req.admit_t = r["admit_t"]
+            req.first_token_t = r["first_token_t"]
+            adopted.append(req)
+        live = [req for req in adopted if not req.done]
+        live_rids = ({q.rid for q in self.sched.running}
+                     | {q.rid for q in self.sched.waiting})
+        for req in live:
+            self.sched.check_servable(req)
+            if req.rid in live_rids:
+                raise ValueError(
+                    f"adopt: rid {req.rid} collides with a live "
+                    "request — migration requires a fleet-global rid "
+                    "namespace")
+        if self.sched.max_queue is not None and \
+                len(self.sched.waiting) + len(live) > self.sched.max_queue:
+            raise ValueError(
+                f"adopt: {len(live)} live records exceed queue "
+                f"headroom ({len(self.sched.waiting)}/"
+                f"{self.sched.max_queue} waiting)")
+        for req in adopted:
+            self._next_rid = max(self._next_rid, req.rid + 1)
+            if req.done:
+                self._finish_restored(req)
+            else:
+                req.state = WAITING
+                self.sched.waiting.append(req)
+        return adopted
 
     def _finish_restored(self, req: Request) -> None:
         """Retire a request that was already done when the crash hit
@@ -1055,20 +1135,33 @@ class ServingEngine:
         except (DeviceLossError, PagePoolCorruption) as e:
             self._handle_fault(e)
 
-    def run(self, max_steps: int = 100_000) -> List[Request]:
+    def run(self, max_steps: int = 100_000, *,
+            raise_on_stall: bool = True) -> List[Request]:
         """Step until every queued request has finished; returns the
-        finished list (scheduler order)."""
+        finished list (scheduler order).  Exhausting ``max_steps``
+        with live requests still queued is a STALL: a
+        ``serving_stall`` event is emitted either way (a wedged fleet
+        member must be observable, not quietly partial — ISSUE 16),
+        then the engine raises, or returns the partial finished list
+        under ``raise_on_stall=False``."""
         for _ in range(max_steps):
             if self.sched.idle:
                 break
             self._guarded_step()
         else:
-            raise RuntimeError(f"engine did not drain in {max_steps} steps")
+            self._emit("serving_stall",
+                       waiting=len(self.sched.waiting),
+                       running=len(self.sched.running),
+                       budget=max_steps)
+            if raise_on_stall:
+                raise RuntimeError(
+                    f"engine did not drain in {max_steps} steps")
         self._retire(self.clock())
         return self.sched.finished
 
     def serve(self, trace: Sequence[Request], *,
-              max_steps: int = 1_000_000) -> List[Request]:
+              max_steps: int = 1_000_000,
+              raise_on_stall: bool = True) -> List[Request]:
         """Run an arrival trace (requests sorted by ``arrival_t``):
         each request is submitted once the clock passes its arrival
         time; with a real clock the engine sleeps through idle gaps,
@@ -1108,6 +1201,12 @@ class ServingEngine:
             else:
                 break
         else:
-            raise RuntimeError(f"trace did not drain in {max_steps} steps")
+            self._emit("serving_stall",
+                       waiting=len(self.sched.waiting),
+                       running=len(self.sched.running),
+                       budget=max_steps)
+            if raise_on_stall:
+                raise RuntimeError(
+                    f"trace did not drain in {max_steps} steps")
         self._retire(self.clock())
         return self.sched.finished

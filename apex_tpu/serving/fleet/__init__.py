@@ -1,0 +1,62 @@
+"""Serving fleet tier (ISSUE 16): an SLO-aware router over N
+:class:`~apex_tpu.serving.ServingEngine` replicas.
+
+Three modules, outside-in:
+
+* :mod:`~apex_tpu.serving.fleet.router` — :class:`FleetRouter`:
+  per-tenant :class:`SLOClass` assignment, least-loaded placement on
+  live telemetry signals, retry-with-backoff on replica fault, fencing
+  + live migration, :func:`rolling_restart`, and the autoscaling
+  *signal* (:func:`scale_hint` — never an action).
+* :mod:`~apex_tpu.serving.fleet.replica` — :class:`ReplicaProxy`: the
+  in-process stand-in for the process/RPC boundary.  The router talks
+  ONLY to this surface (submit/step/ping/snapshot/adopt/restart), so
+  promoting a replica to its own process later changes the proxy, not
+  the router.
+* :mod:`~apex_tpu.serving.fleet.migrate` — the migration planner:
+  pure partition of snapshot records over healthy targets, headroom-
+  and geometry-validated before any engine mutates, loud
+  :class:`FleetCapacityError` instead of silent drops.
+
+See docs/serving.md "Fleet tier" for the router policy, the migration
+contract (what is and isn't bitwise), and the fence/backoff state
+machine.
+"""
+
+from apex_tpu.serving.fleet.migrate import (  # noqa: F401
+    FleetCapacityError,
+    plan_migration,
+)
+from apex_tpu.serving.fleet.replica import (  # noqa: F401
+    FENCED,
+    HEALTHY,
+    RESTARTING,
+    HealthCheckTimeout,
+    ReplicaDead,
+    ReplicaProxy,
+    set_fleet_fault_hook,
+)
+from apex_tpu.serving.fleet.router import (  # noqa: F401
+    FleetRouter,
+    SLOClass,
+    rolling_restart,
+    scale_hint,
+    scale_hint_from_events,
+)
+
+__all__ = [
+    "FleetRouter",
+    "SLOClass",
+    "rolling_restart",
+    "scale_hint",
+    "scale_hint_from_events",
+    "ReplicaProxy",
+    "ReplicaDead",
+    "HealthCheckTimeout",
+    "set_fleet_fault_hook",
+    "HEALTHY",
+    "FENCED",
+    "RESTARTING",
+    "FleetCapacityError",
+    "plan_migration",
+]

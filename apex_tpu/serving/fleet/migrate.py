@@ -1,0 +1,95 @@
+"""Migration planning: partition a fenced replica's snapshot records
+over healthy targets, validated before any engine mutates.
+
+The planner is PURE — it reads target headroom/geometry and returns an
+assignment; execution (``adopt`` per target, re-handling, telemetry)
+stays in the router.  Pure planning is what makes refusal atomic at
+the fleet level: if any live record cannot be placed, the plan raises
+:class:`FleetCapacityError` and nothing has moved — zero silent drops,
+the snapshot is intact, and the operator sees exactly which request
+did not fit.
+
+Records travel in the engine's snapshot format (format 1, host-only,
+JSON-serializable by construction); the router round-trips the
+snapshot through ``json`` before planning, so the in-process fast path
+exercises the same serialization a process/RPC boundary will.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence
+
+
+class FleetCapacityError(RuntimeError):
+    """No healthy target can take a live migrating request."""
+
+
+def _servable_by(target, record: Dict[str, Any]) -> bool:
+    """Geometry check without mutating the target: mirrors
+    ``check_servable`` over the snapshot record's worst case."""
+    sched = target.engine.sched
+    cache = target.engine.cache
+    worst = len(record["prompt"]) + int(record["max_new_tokens"])
+    if worst > sched.max_position:
+        return False
+    if cache.pages_needed(worst) > cache.max_pages_per_request:
+        return False
+    if worst > sched.prefill_budget and sched.chunk_size is None:
+        return False
+    return True
+
+
+def plan_migration(records: Sequence[Dict[str, Any]],
+                   targets: Sequence) -> Dict[str, List[Dict[str, Any]]]:
+    """Assign snapshot ``records`` to healthy ``targets``
+    (:class:`~apex_tpu.serving.fleet.replica.ReplicaProxy`), least
+    loaded first, respecting each target's bounded-queue headroom and
+    geometry.  Returns ``{replica_name: [records...]}`` covering EVERY
+    record, or raises :class:`FleetCapacityError` — a migration plan
+    never quietly sheds.
+
+    Done-at-capture records retire immediately on adoption (they never
+    enter the waiting queue), so they don't consume headroom; live
+    records do.  Assignment order is rid order for determinism."""
+    if not targets:
+        raise FleetCapacityError(
+            f"no healthy targets for {len(records)} migrating requests")
+    plan: Dict[str, List[Dict[str, Any]]] = {t.name: [] for t in targets}
+    headroom = {t.name: t.queue_headroom() for t in targets}
+    # fractional load tiebreak frozen at plan time; planned placements
+    # added on top so a burst spreads instead of piling on one target
+    load = {t.name: t.load_score() for t in targets}
+    by_name = {t.name: t for t in targets}
+    done = [r for r in records if _record_done(r)]
+    live = [r for r in records if not _record_done(r)]
+    for rec in sorted(live, key=lambda r: int(r["rid"])):
+        candidates = [
+            n for n, t in by_name.items()
+            if (headroom[n] is None or headroom[n] > 0)
+            and _servable_by(t, rec)
+        ]
+        if not candidates:
+            raise FleetCapacityError(
+                f"request {rec['rid']} fits no healthy target "
+                f"(headroom {dict(headroom)}) — refuse the whole plan, "
+                "drop nothing")
+        name = min(candidates, key=lambda n: (load[n], n))
+        plan[name].append(rec)
+        load[name] += 1
+        if headroom[name] is not None:
+            headroom[name] -= 1
+    for rec in sorted(done, key=lambda r: int(r["rid"])):
+        name = min(by_name, key=lambda n: (load[n], n))
+        plan[name].append(rec)
+    return plan
+
+
+def _record_done(rec: Dict[str, Any]) -> bool:
+    """Snapshot-record twin of ``Request.done``: generation budget
+    exhausted or EOS sampled (the engine retires these immediately on
+    adopt instead of re-prefilling past max_new_tokens)."""
+    gen = rec["generated"]
+    if len(gen) >= int(rec["max_new_tokens"]):
+        return True
+    eos = rec["eos_id"]
+    return eos is not None and bool(gen) and gen[-1] == eos

@@ -1,0 +1,408 @@
+"""The SLO-aware fleet router (ISSUE 16 tentpole).
+
+:class:`FleetRouter` fronts N replicas behind one submit surface:
+
+* **Placement** — :meth:`~FleetRouter.route` picks the least-loaded
+  healthy replica with bounded-queue headroom (signals:
+  queue depth + running batch + page-pool occupancy, all read through
+  the :class:`~apex_tpu.serving.fleet.replica.ReplicaProxy` seam).
+  When every bounded queue is full, the pick falls back to the
+  least-loaded healthy replica so the ENGINE rejects loudly
+  (``request_reject`` ``reason="queue_full"``) instead of the router
+  inventing a second shedding policy.
+* **SLO classes** — deadlines are existing per-request knobs; the
+  router just assigns them per tenant class
+  (:class:`SLOClass`), so SLO enforcement stays where it already
+  works: the engine's shed/timeout machinery.
+* **Fault handling, two nested nets** — an engine absorbs device
+  faults up to its own ``max_recoveries``; only then does the fault
+  propagate to the router, which retries the replica with exponential
+  round backoff up to ``fault_retries`` before FENCING it: out of
+  rotation, ``replica_fence`` emitted, live requests migrated.
+* **Migration** — ``snapshot()`` → JSON round-trip (the
+  serializability pin for the later RPC boundary) →
+  :func:`~apex_tpu.serving.fleet.migrate.plan_migration` →
+  ``adopt()`` per target.  Atomic at both levels (plan refuses whole,
+  adopt validates before mutating); every hop is a
+  ``request_migrate`` event; zero silent drops.  Migrated streams are
+  bitwise the unmigrated control's — KV is rebuilt by deterministic
+  re-prefill, exactly the single-engine recovery contract.
+* **Rolling restart** — :func:`rolling_restart` drains, migrates,
+  restarts and readmits one replica at a time; a fleet of one
+  readmits its own snapshot after the restart (nothing to migrate
+  onto).
+* **Autoscaling signal** — :func:`scale_hint` is a pure function of
+  shed rate / occupancy / deadline attainment; the router only ever
+  EMITS ``fleet_scale_hint`` (testable against recorded traces via
+  :func:`scale_hint_from_events`) — acting on it is the operator's
+  job.
+
+The router owns the fleet-global rid namespace and the rid → handle
+map (``handles``); a handle *is* a rid, which is what survives an RPC
+boundary.  All replicas share ONE clock — per-replica clocks would
+skew deadline math across a migration hop.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from apex_tpu.serving.fleet.migrate import plan_migration
+from apex_tpu.serving.fleet.replica import (FENCED, HealthCheckTimeout,
+                                            ReplicaProxy)
+from apex_tpu.serving.kv_cache import PagePoolCorruption
+from apex_tpu.serving.scheduler import Request
+
+
+@dataclass(frozen=True)
+class SLOClass:
+    """A tenant tier mapped onto existing per-request knobs: the
+    router assigns ``deadline_s`` at submit; ``None`` = best effort
+    (no deadline, shed last)."""
+
+    name: str
+    deadline_s: Optional[float] = None
+
+
+def scale_hint(*, shed_rate: float, occupancy: float,
+               deadline_hit_rate: Optional[float] = None) -> str:
+    """The autoscaling SIGNAL (never an action): pure thresholds over
+    the three pressure signals the serving tier already measures.
+    Shedding or missed deadlines mean the fleet is refusing work it
+    was asked to do — scale up; a near-idle pool with perfect SLO
+    attainment is paying for capacity it does not use — scale down;
+    anything between holds."""
+    if shed_rate > 0.05 or occupancy > 0.85:
+        return "scale_up"
+    if deadline_hit_rate is not None and deadline_hit_rate < 0.90:
+        return "scale_up"
+    if shed_rate == 0.0 and occupancy < 0.25 and (
+            deadline_hit_rate is None or deadline_hit_rate >= 0.99):
+        return "scale_down"
+    return "hold"
+
+
+def scale_hint_from_events(events: Sequence[Dict[str, Any]]) -> str:
+    """Derive the hint from a RECORDED telemetry stream (a list of
+    schema-valid event dicts), so the policy is testable against
+    traces without standing a fleet up.  Terminal outcomes =
+    retires + rejects + timeouts; shed rate counts the refused/dropped
+    share; occupancy averages ``decode_step`` pool pressure over the
+    allocatable pool (page 0 is scratch)."""
+    retires = [e for e in events if e.get("type") == "request_retire"]
+    rejects = [e for e in events if e.get("type") == "request_reject"]
+    timeouts = [e for e in events if e.get("type") == "request_timeout"]
+    steps = [e for e in events if e.get("type") == "decode_step"]
+    total = len(retires) + len(rejects) + len(timeouts)
+    shed_rate = (len(rejects) + len(timeouts)) / max(1, total)
+    occ = 0.0
+    if steps:
+        occ = sum(e["pool_used"] / max(1, e["pool_pages"] - 1)
+                  for e in steps) / len(steps)
+    hits = [e["deadline_hit"] for e in retires if "deadline_hit" in e]
+    hit_rate = (sum(1 for h in hits if h) / len(hits)) if hits else None
+    return scale_hint(shed_rate=shed_rate, occupancy=occ,
+                      deadline_hit_rate=hit_rate)
+
+
+class FleetRouter:
+    """Route requests over ``replicas``
+    (:class:`~apex_tpu.serving.fleet.replica.ReplicaProxy`), fencing
+    and migrating around faults.  ``fault_retries`` is the
+    router-level retry budget AFTER a replica's engine has exhausted
+    its own recoveries; ``health_timeout_s`` is the deterministic ping
+    latency budget; ``scale_hint_every`` emits ``fleet_scale_hint``
+    every N fleet rounds (0 = never).  ``on_round`` fires once at the
+    end of every fleet round — the virtual-clock injection point: all
+    replicas step CONCURRENTLY in a real fleet, so a shared
+    :class:`~apex_tpu.serving.engine.SimClock` (which ticks per
+    engine step, i.e. N ticks per round) would charge N replicas N×
+    the time of one; a router-ticked clock charges one round one
+    tick regardless of fleet width (bench_fleet measures TTFT on
+    exactly this)."""
+
+    def __init__(self, replicas: Sequence[ReplicaProxy], *,
+                 telemetry=None,
+                 slo_classes: Sequence[SLOClass] = (),
+                 fault_retries: int = 2,
+                 health_timeout_s: float = 0.25,
+                 scale_hint_every: int = 50,
+                 on_round: Optional[Callable[[], None]] = None):
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        names = [r.name for r in replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate replica names: {names}")
+        self.replicas: List[ReplicaProxy] = list(replicas)
+        self._by_name = {r.name: r for r in self.replicas}
+        self.telemetry = telemetry
+        self.slo_classes = {c.name: c for c in slo_classes}
+        self.fault_retries = int(fault_retries)
+        self.health_timeout_s = float(health_timeout_s)
+        self.scale_hint_every = int(scale_hint_every)
+        self.on_round = on_round
+        #: fleet-global rid namespace — rid collisions across replicas
+        #: would make migration ambiguous (pinned in adopt())
+        self._next_rid = 0
+        #: rid -> live Request handle; REBOUND on migration (the old
+        #: engine's object is dead).  A handle is a rid — the only
+        #: thing that survives an RPC boundary.
+        self.handles: Dict[int, Request] = {}
+        #: rid -> replica name (current placement)
+        self.placement: Dict[int, str] = {}
+        self.round = 0
+
+    # -- lifecycle -------------------------------------------------------
+
+    def warmup(self) -> float:
+        return sum(rep.warmup() for rep in self.replicas)
+
+    # -- intake ----------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
+               eos_id: Optional[int] = None,
+               slo: Optional[str] = None,
+               deadline_s: Optional[float] = None,
+               arrival_t: Optional[float] = None) -> int:
+        """Place one request on the fleet; returns its rid (THE
+        handle).  ``slo`` names a registered :class:`SLOClass` whose
+        deadline overrides ``deadline_s``; rejection semantics are the
+        engine's (terminal ``rejected`` + ``request_reject`` event) —
+        check ``handles[rid].finish_reason``."""
+        if slo is not None:
+            cls = self.slo_classes.get(slo)
+            if cls is None:
+                raise ValueError(
+                    f"unknown SLO class {slo!r}; registered: "
+                    f"{sorted(self.slo_classes)}")
+            deadline_s = cls.deadline_s
+        rep = self.route()
+        rid = self._next_rid
+        self._next_rid += 1
+        req = Request(rid=rid, prompt=list(prompt),
+                      max_new_tokens=max_new_tokens, eos_id=eos_id,
+                      arrival_t=(rep.engine.clock() if arrival_t is None
+                                 else arrival_t),
+                      deadline_s=deadline_s)
+        rep.engine.submit_request(req)
+        self.handles[rid] = req
+        self.placement[rid] = rep.name
+        return rid
+
+    def route(self) -> ReplicaProxy:
+        """Pick the least-loaded healthy replica, preferring ones with
+        bounded-queue headroom; with every queue full the least-loaded
+        healthy replica takes the submission and its engine rejects
+        loudly (backpressure stays ONE policy, the engine's).  Raises
+        when no replica is healthy — a dead fleet is not a routing
+        decision."""
+        healthy = [r for r in self.replicas if r.healthy]
+        if not healthy:
+            raise RuntimeError("no healthy replicas in the fleet")
+        with_room = [r for r in healthy
+                     if r.queue_headroom() is None or r.queue_headroom() > 0]
+        pool = with_room or healthy
+        return min(pool, key=lambda r: (r.load_score(), r.name))
+
+    # -- health + fencing ------------------------------------------------
+
+    def _health_check(self) -> None:
+        """Probe every in-rotation replica; a timeout fences it on the
+        spot and reroutes its live requests — the router NEVER blocks
+        on a black hole (the probe is virtual-latency, no sleep)."""
+        for rep in self.replicas:
+            if not rep.healthy:
+                continue
+            try:
+                rep.ping(self.health_timeout_s)
+            except HealthCheckTimeout:
+                self._fence(rep, cause="health_check_timeout")
+
+    def _fence(self, rep: ReplicaProxy, cause: str,
+               migrate: bool = True) -> None:
+        live = rep.queue_depth() + rep.running()
+        rep.fence()
+        self._emit("replica_fence", replica=rep.name, cause=cause,
+                   live_requests=live, recoveries=rep.engine.recoveries,
+                   fault_retries=rep.fault_attempts)
+        if migrate:
+            self._migrate_requests(rep)
+
+    def _migrate_requests(self, source: ReplicaProxy) -> List[Request]:
+        """Move every live request off ``source`` onto healthy peers.
+        The snapshot is JSON round-tripped (the serializability pin —
+        the in-process path must exercise exactly what an RPC boundary
+        will carry), the plan validates headroom + geometry before any
+        adopt, and each adopt validates atomically again — a failure
+        anywhere leaves every engine untouched and raises loudly.
+        Handles are REBOUND to the adopting engine's request objects;
+        token streams continue bitwise (deterministic re-prefill)."""
+        snap = json.loads(json.dumps(source.snapshot()))
+        records = snap["requests"]
+        if not records:
+            return []
+        targets = [r for r in self.replicas
+                   if r.healthy and r.name != source.name]
+        plan = plan_migration(records, targets)
+        moved: List[Request] = []
+        for name, recs in sorted(plan.items()):
+            if not recs:
+                continue
+            adopted = self._by_name[name].adopt(recs)
+            for req, rec in zip(adopted, recs):
+                self.handles[req.rid] = req
+                self.placement[req.rid] = name
+                self._emit("request_migrate", rid=req.rid,
+                           from_replica=source.name, to_replica=name,
+                           tokens_done=len(req.generated),
+                           was_running=bool(rec["was_running"]))
+                moved.append(req)
+        return moved
+
+    # -- the fleet round -------------------------------------------------
+
+    def step(self) -> None:
+        """One fleet round: health-check everything, then step each
+        in-rotation replica with work.  A propagated fault (the
+        engine's own recovery budget is already spent by the time it
+        reaches here) costs one retry: the replica sits out
+        ``2^attempts`` rounds of backoff, and past ``fault_retries``
+        it is fenced and drained."""
+        from apex_tpu.resilience.chaos import DeviceLossError
+
+        self.round += 1
+        self._health_check()
+        for rep in self.replicas:
+            if not rep.healthy or rep.idle:
+                continue
+            if rep.backoff_until > self.round:
+                continue
+            try:
+                rep.step()
+            except (DeviceLossError, PagePoolCorruption) as e:
+                rep.fault_attempts += 1
+                if rep.fault_attempts > self.fault_retries:
+                    self._fence(rep, cause=type(e).__name__)
+                else:
+                    rep.backoff_until = self.round + (1 << rep.fault_attempts)
+        if self.on_round is not None:
+            self.on_round()
+
+    def run(self, max_steps: int = 100_000) -> List[Request]:
+        """Round until every in-rotation replica drains; returns the
+        handles in rid order.  Non-drain raises — a backing-off
+        replica still counts as live work, so the budget must cover
+        backoff rounds too."""
+        for _ in range(max_steps):
+            busy = [r for r in self.replicas if r.healthy and not r.idle]
+            if not busy:
+                break
+            self.step()
+            if self.scale_hint_every and \
+                    self.round % self.scale_hint_every == 0:
+                self.emit_scale_hint()
+        else:
+            raise RuntimeError(
+                f"fleet did not drain in {max_steps} rounds")
+        for rep in self.replicas:
+            if rep.healthy:
+                rep.engine._retire(rep.engine.clock())
+        return [self.handles[rid] for rid in sorted(self.handles)]
+
+    # -- autoscaling signal ----------------------------------------------
+
+    def signals(self) -> Dict[str, Any]:
+        """Fleet-aggregate pressure signals over in-rotation replicas
+        (the inputs to :func:`scale_hint`, also emitted verbatim on
+        ``fleet_scale_hint`` so recorded traces can replay the
+        decision)."""
+        healthy = [r for r in self.replicas if r.healthy]
+        occ = (sum(r.occupancy() for r in healthy) / len(healthy)
+               if healthy else 1.0)
+        shed = sum(r.shed_count() for r in healthy)
+        shed_rate = shed / max(1, len(self.handles))
+        hits = []
+        for rep in healthy:
+            for req in rep.engine.sched.finished:
+                if req.deadline_t is not None and req.finish_t is not None:
+                    hits.append(req.finish_t <= req.deadline_t)
+        hit_rate = (sum(1 for h in hits if h) / len(hits)) if hits else None
+        return {"shed_rate": shed_rate, "occupancy": occ,
+                "deadline_hit_rate": hit_rate,
+                "replicas": len(self.replicas), "healthy": len(healthy)}
+
+    def emit_scale_hint(self) -> str:
+        sig = self.signals()
+        hint = scale_hint(shed_rate=sig["shed_rate"],
+                          occupancy=sig["occupancy"],
+                          deadline_hit_rate=sig["deadline_hit_rate"])
+        ev = dict(hint=hint, shed_rate=sig["shed_rate"],
+                  occupancy=sig["occupancy"], replicas=sig["replicas"],
+                  healthy=sig["healthy"])
+        if sig["deadline_hit_rate"] is not None:
+            # optional means absent, never a sentinel
+            ev["deadline_hit_rate"] = sig["deadline_hit_rate"]
+        self._emit("fleet_scale_hint", **ev)
+        return hint
+
+    # -- plumbing --------------------------------------------------------
+
+    def _emit(self, type_: str, **payload) -> None:
+        if self.telemetry is not None:
+            self.telemetry.emit(type_, step=self.round, **payload)
+
+
+def rolling_restart(router: FleetRouter, *, serve_between: int = 0) -> None:
+    """Drain, migrate, restart, readmit — one replica at a time, so
+    N-1 replicas keep serving and p99 TTFT holds (the bench_fleet
+    restart segment gates this).  Each replica's turn: fence with
+    ``cause="rolling_restart"`` (out of rotation + ``replica_fence``
+    event), migrate its live requests onto the still-healthy peers,
+    rebuild its engine from the factory (fresh warmup — zero compiles
+    later, by the standing contract), and rejoin rotation empty.
+    ``serve_between`` is the replica's DOWNTIME WINDOW in fleet
+    rounds: those rounds run between its fence and its restart, so
+    the still-healthy peers keep serving (first tokens keep landing)
+    while the replica is conceptually down — the in-process stand-in
+    for peers serving concurrently while one process respawns.
+
+    A fleet of ONE has nowhere to migrate: it snapshots, sits out the
+    same downtime window with NOTHING serving (its queue just ages —
+    the honest cost of single-replica stop-the-world), restarts, and
+    re-adopts its own records.
+
+    FENCED replicas rejoin too: their live requests already migrated
+    at fence time, so a bare restart returns them to rotation — the
+    rolling restart is also the repair operation after a chaos kill."""
+    for rep in list(router.replicas):
+        if not rep.healthy:
+            # fenced at some earlier fault: drained already, restart
+            # brings it back empty
+            if rep.state == FENCED:
+                rep.restart()
+            continue
+        peers = [r for r in router.replicas
+                 if r.healthy and r.name != rep.name]
+        if peers:
+            router._fence(rep, cause="rolling_restart")
+            for _ in range(serve_between):
+                router.step()
+            rep.restart()
+        else:
+            snap = json.loads(json.dumps(rep.snapshot()))
+            router._fence(rep, cause="rolling_restart", migrate=False)
+            for _ in range(serve_between):
+                router.step()
+            rep.restart()
+            records = snap["requests"]
+            if records:
+                adopted = rep.adopt(records)
+                for req, rec in zip(adopted, records):
+                    router.handles[req.rid] = req
+                    router.placement[req.rid] = rep.name
+                    router._emit("request_migrate", rid=req.rid,
+                                 from_replica=rep.name, to_replica=rep.name,
+                                 tokens_done=len(req.generated),
+                                 was_running=bool(rec["was_running"]))

@@ -48,6 +48,13 @@ GATED_LOWER = (
     # direction is pinned by test_bucket_ms_direction_rule), not extra
     # coverage: a key renamed off the _ms suffix un-gates either way.
     r"_bucket_\w*_ms$",
+    # ISSUE 16: the fleet tail-latency family (fleet_ttft_p99_steady_ms
+    # / fleet_ttft_p99_restart_ms).  Deliberately redundant with the
+    # ttft/_ms$/_p99 rules above, same as the bucket family: this entry
+    # DOCUMENTS that the committed r16 pair gates on the family (the
+    # direction is pinned by test_fleet_key_direction_rules), it adds
+    # no new coverage.
+    r"fleet_ttft_\w*_ms$",
 )
 
 #: Higher-is-better key patterns: throughput, efficiency, rooflines,
@@ -58,6 +65,9 @@ GATED_HIGHER = (
     r"_per_sec$", r"_tflops$", r"_mfu", r"goodput$", r"_speedup",
     r"_gb_s$", r"frac_of_roof$", r"frac_of_dot_floor$", r"_min_ratio$",
     r"_hit_rate$", r"_accepted_tokens_per_step$",
+    # ISSUE 16: fleet aggregate throughput (documented-redundant with
+    # _per_sec$, same contract as the fleet_ttft entry above)
+    r"fleet_\w*_tokens_per_sec$",
 )
 
 

@@ -44,20 +44,26 @@ class FieldSpec(NamedTuple):
     ``required=False`` fields are OPTIONAL — absent entirely when the
     producer has nothing to say (a one-token request has no TPOT, a
     CPU backend has no HBM stats).  Optionality must be explicit in
-    the schema, never smuggled via sentinel values."""
+    the schema, never smuggled via sentinel values.
+
+    ``choices`` (ISSUE 16) closes a string field over an enum: a
+    reason/hint field whose consumers branch on its value must not
+    grow ad-hoc spellings — ``validate_event`` rejects values outside
+    the set, the same single-source discipline as bool-not-int."""
 
     types: tuple
     required: bool = True
+    choices: tuple = ()
 
 
-def opt(*types) -> FieldSpec:
+def opt(*types, choices=()) -> FieldSpec:
     """An optional field spec (shorthand for the table below)."""
-    return FieldSpec(tuple(types), required=False)
+    return FieldSpec(tuple(types), required=False, choices=tuple(choices))
 
 
-def req(*types) -> FieldSpec:
+def req(*types, choices=()) -> FieldSpec:
     """A required field spec (shorthand for the table below)."""
-    return FieldSpec(tuple(types), required=True)
+    return FieldSpec(tuple(types), required=True, choices=tuple(choices))
 
 
 #: The closed event vocabulary WITH its per-field contracts.  Every
@@ -148,6 +154,8 @@ EVENT_FIELDS: Dict[str, Dict[str, FieldSpec]] = {
         "delay_s": opt(*NUMBER),
         "page": opt(int),
         "use_signal": opt(bool),
+        # fleet chaos (ISSUE 16): the replica the injector targeted
+        "replica": opt(str),
     },
     # pipeline-parallel Timers.log snapshot
     "timers": {
@@ -216,10 +224,14 @@ EVENT_FIELDS: Dict[str, Dict[str, FieldSpec]] = {
     },
     # serving resilience (ISSUE 10): overload rejects, deadline deaths
     # (where = "queued" shed / "running" timeout), crash recovery.
-    # pool_rebuilt is a REAL bool (bool-not-int discipline)
+    # pool_rebuilt is a REAL bool (bool-not-int discipline).
+    # reason is CLOSED (ISSUE 16): "queue_full" is backpressure (retry
+    # elsewhere / later), "unservable" is permanent refusal by this
+    # engine's geometry (retrying the same replica is futile) — the
+    # fleet router branches on exactly this distinction
     "request_reject": {
         "rid": req(int),
-        "reason": req(str),
+        "reason": req(str, choices=("queue_full", "unservable")),
         "queue_depth": req(int),
     },
     "request_timeout": {
@@ -232,6 +244,44 @@ EVENT_FIELDS: Dict[str, Dict[str, FieldSpec]] = {
         "pool_rebuilt": req(bool),
         "running_restored": req(int),
         "waiting_restored": req(int),
+    },
+    # a wedged engine is observable (ISSUE 16 satellite): run()/serve()
+    # exhausted their step budget with live requests still queued
+    "serving_stall": {
+        "waiting": req(int),
+        "running": req(int),
+        "budget": req(int),
+    },
+    # serving fleet (ISSUE 16): a replica leaving rotation (its engine
+    # burned through max_recoveries, its health check timed out, or a
+    # rolling restart is draining it), each live request's migration
+    # hop, and the autoscaling SIGNAL (never an action) derived from
+    # SLO attainment / shed rate / pool occupancy
+    "replica_fence": {
+        "replica": req(str),
+        "cause": req(str),
+        "live_requests": req(int),
+        "recoveries": opt(int),
+        "fault_retries": opt(int),
+    },
+    "request_migrate": {
+        "rid": req(int),
+        "from_replica": req(str),
+        "to_replica": req(str),
+        "tokens_done": req(int),
+        # a REAL bool: the request was mid-flight (holding pages) on
+        # the source when fenced, vs still queued
+        "was_running": req(bool),
+    },
+    "fleet_scale_hint": {
+        "hint": req(str, choices=("scale_up", "hold", "scale_down")),
+        "shed_rate": req(*NUMBER),
+        "occupancy": req(*NUMBER),
+        "replicas": req(int),
+        "healthy": req(int),
+        # absent when no request carried a deadline in the window —
+        # optional means absent, never a sentinel
+        "deadline_hit_rate": opt(*NUMBER),
     },
     # in-run attribution (ISSUE 9): the ProfileSampler's window result.
     # exposed_collective_ms is the overlap-analysis headline;
@@ -290,7 +340,8 @@ def _type_names(types: tuple) -> str:
     return "/".join(t.__name__ for t in types)
 
 
-def _check_field(etype: str, field: str, v: Any, types: tuple) -> None:
+def _check_field(etype: str, field: str, v: Any, types: tuple,
+                 choices: tuple = ()) -> None:
     # bool is an int subclass; an int-typed field must not accept it
     if isinstance(v, bool) and bool not in types:
         raise SchemaError(
@@ -299,6 +350,9 @@ def _check_field(etype: str, field: str, v: Any, types: tuple) -> None:
         raise SchemaError(
             f"{etype}.{field} must be {_type_names(types)}, got "
             f"{type(v).__name__} ({v!r})")
+    if choices and v not in choices:
+        raise SchemaError(
+            f"{etype}.{field} must be one of {sorted(choices)}, got {v!r}")
 
 
 def validate_event(event: Any) -> Dict[str, Any]:
@@ -330,7 +384,7 @@ def validate_event(event: Any) -> Dict[str, Any]:
                     f"{etype} event missing required field {field!r}: "
                     f"{event}")
             continue
-        _check_field(etype, field, event[field], spec.types)
+        _check_field(etype, field, event[field], spec.types, spec.choices)
     try:
         json.dumps(event)
     except (TypeError, ValueError) as e:

@@ -1996,6 +1996,200 @@ def bench_serving():
     }
 
 
+def bench_fleet():
+    """Serving-fleet bench (ISSUE 16): aggregate decode throughput vs
+    replica count, and p99 TTFT THROUGH a rolling restart.
+
+    Two measured segments on one N-replica fleet
+    (``BENCH_FLEET_REPLICAS``, default 3; the committed r16 pair is
+    the 1-replica vs 3-replica A/B):
+
+    * **steady** — ``BENCH_FLEET_REQS`` requests submitted up front
+      (deterministic, comparable across replica counts), drained;
+      ``fleet_decode_tokens_per_sec`` is generated tokens over the
+      drain time, ``fleet_ttft_p99_steady_ms`` the request-level tail.
+    * **restart** — the same request load resubmitted, a few fleet
+      rounds in, then :func:`rolling_restart` (drain → migrate →
+      downtime window → restart → readmit, one replica at a time) and
+      the drain completes under :func:`hot_path_guard`:
+      ``fleet_ttft_p99_restart_ms`` must hold near the steady tail
+      (the regress gate compares the committed pair) and
+      ``fleet_recompiles_after_warmup`` must stay 0 — every receiving
+      replica serves migrated work on its warmed executables.
+
+    Time is VIRTUAL: one fleet round = ``round_dt`` (10 ms), ticked by
+    the router's ``on_round`` hook, shared by every replica's engine
+    clock.  In-process replicas step sequentially on one host, so
+    wall-clock would charge N concurrent replicas N× the time of one
+    (and charge serving for XLA re-warm walls) — virtual time measures
+    what the fleet tier actually owns: placement, migration, and
+    availability through the restart's downtime window.  It also makes
+    the gated keys DETERMINISTIC for a given seed/config — the
+    committed pair gates scheduling quality, not host noise.  Real
+    walls still ride along informationally (``fleet_*_wall_s``,
+    ``fleet_compile_s``).
+
+    The whole run lands on one schema-validated telemetry stream
+    (``telemetry/fleet.jsonl``): admits/retires/decode steps from
+    every engine, ``replica_fence``/``request_migrate`` from the
+    restart arc, and a final ``fleet_scale_hint`` per segment."""
+    import random as _random
+
+    from apex_tpu import telemetry as tel
+    from apex_tpu.analysis import hot_path_guard
+    from apex_tpu.serving import (ServingEngine, ServingModelConfig,
+                                  init_params)
+    from apex_tpu.telemetry.summarize import percentile
+    from apex_tpu.serving.fleet import (FleetRouter, ReplicaProxy, SLOClass,
+                                        rolling_restart)
+
+    n_rep = int(os.environ.get("BENCH_FLEET_REPLICAS", "3"))
+    L = int(os.environ.get("BENCH_FLEET_LAYERS", "4"))
+    H = int(os.environ.get("BENCH_FLEET_HIDDEN", "256"))
+    NH = int(os.environ.get("BENCH_FLEET_HEADS", "8"))
+    V = int(os.environ.get("BENCH_FLEET_VOCAB", "1024"))
+    n_req = int(os.environ.get("BENCH_FLEET_REQS", "18"))
+    max_batch = int(os.environ.get("BENCH_FLEET_BATCH", "4"))
+    page_size = int(os.environ.get("BENCH_FLEET_PAGE", "16"))
+    max_pos = int(os.environ.get("BENCH_FLEET_MAXPOS", "256"))
+    pre_rounds = int(os.environ.get("BENCH_FLEET_PRE_ROUNDS", "3"))
+
+    cfg = ServingModelConfig(
+        vocab_size=V, hidden_size=H, num_heads=NH, num_layers=L,
+        max_position=max_pos, dtype=jnp.bfloat16)
+    params = init_params(cfg, seed=0)
+    prompt_len = (max(4, max_pos // 16), max(8, max_pos // 4))
+    max_new = (max(2, max_pos // 64), max(4, max_pos // 16))
+    pages_per_req = -(-(prompt_len[1] + max_new[1]) // page_size)
+    num_pages = 1 + max_batch * pages_per_req * 3 // 2
+
+    tel_dir = os.environ.get("BENCH_TELEMETRY_DIR") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "telemetry")
+    stream = os.path.join(tel_dir, "fleet.jsonl")
+    try:
+        os.remove(stream)
+    except OSError:
+        pass
+    mem = tel.MemorySink()
+    bus = tel.TelemetryBus(run_id=f"fleet-{os.getpid()}",
+                           sinks=[tel.JsonlSink(stream), mem])
+
+    class _VClock:
+        """Fleet virtual time: one tick per fleet ROUND (router
+        ``on_round``), not per engine step — N concurrent replicas
+        cost one round one tick.  Plain callable, so the engines'
+        per-step SimClock auto-advance does not apply."""
+
+        def __init__(self, dt):
+            self.t, self.dt = 0.0, dt
+
+        def __call__(self):
+            return self.t
+
+        def tick(self):
+            self.t += self.dt
+
+    clk = _VClock(0.01)  # 10 virtual ms per fleet round
+
+    def factory():
+        return ServingEngine(cfg, params, num_pages=num_pages,
+                             page_size=page_size, max_batch=max_batch,
+                             max_pages_per_request=pages_per_req,
+                             prefill_budget=max_pos, telemetry=bus,
+                             clock=clk,
+                             # bounded, but wide enough for the
+                             # all-upfront segment load on ONE replica
+                             # (the A side of the committed pair):
+                             # zero drops is a record invariant
+                             max_queue=2 * n_req,
+                             reject_unservable=True)
+
+    fleet = FleetRouter(
+        [ReplicaProxy(f"r{i}", factory) for i in range(n_rep)],
+        telemetry=bus, on_round=clk.tick,
+        slo_classes=[SLOClass("standard"), SLOClass("best_effort")])
+    compile_s = fleet.warmup()
+
+    rng = _random.Random(0)
+
+    def submit_load():
+        rids = []
+        for i in range(n_req):
+            prompt = [rng.randrange(1, V) for _ in range(
+                rng.randrange(*prompt_len))]
+            rids.append(fleet.submit(
+                prompt, max_new_tokens=rng.randrange(*max_new),
+                slo="standard" if i % 2 else "best_effort"))
+        return rids
+
+    def ttft_p99_ms(rids):
+        ttfts = sorted((fleet.handles[r].first_token_t
+                        - fleet.handles[r].arrival_t) * 1e3
+                       for r in rids
+                       if fleet.handles[r].first_token_t is not None)
+        return round(percentile(ttfts, 0.99), 3) if ttfts else None
+
+    # ---- steady segment
+    steady = submit_load()
+    t0, v0 = time.perf_counter(), clk.t
+    fleet.run()
+    steady_wall = time.perf_counter() - t0
+    steady_virtual = clk.t - v0
+    steady_tokens = sum(len(fleet.handles[r].generated) for r in steady)
+    fleet.emit_scale_hint()
+
+    # ---- restart segment: same load shape, rolling restart mid-serve
+    restart = submit_load()
+    for _ in range(pre_rounds):
+        fleet.step()
+    t0 = time.perf_counter()
+    # each replica sits out a 25-round downtime window (re-warm
+    # happens inside, off the virtual clock); peers serve through it,
+    # so first tokens keep landing during the operation — a fleet of
+    # one instead ages its whole queue through every window
+    rolling_restart(fleet, serve_between=25)
+    with hot_path_guard("fleet post-restart drain", transfers=None,
+                        raise_on_sync=False) as g:
+        fleet.run()
+    restart_wall = time.perf_counter() - t0
+    fleet.emit_scale_hint()
+    bus.close()
+
+    n_events = tel.validate_jsonl(stream)  # the acceptance contract
+    moves = sum(1 for e in mem.events if e["type"] == "request_migrate")
+    fences = sum(1 for e in mem.events if e["type"] == "replica_fence")
+    dropped = [r for r in steady + restart
+               if fleet.handles[r].finish_reason
+               not in ("eos", "length")]
+    return {
+        "fleet_requests": len(steady) + len(restart),
+        "fleet_dropped": len(dropped),          # must stay 0
+        "fleet_decode_tokens_per_sec":
+        round(steady_tokens / steady_virtual, 1)
+        if steady_virtual > 0 else None,
+        "fleet_ttft_p99_steady_ms": ttft_p99_ms(steady),
+        "fleet_ttft_p99_restart_ms": ttft_p99_ms(restart),
+        "fleet_steady_wall_s": round(steady_wall, 2),
+        "fleet_restart_wall_s": round(restart_wall, 2),
+        "fleet_recompiles_after_warmup": g.recompiles,
+        "fleet_migrations": moves,
+        "fleet_fences": fences,
+        "fleet_compile_s": round(compile_s, 2),
+        "fleet_stream_events": n_events,
+        "fleet_telemetry_file": os.path.basename(stream),
+        "fleet_config": {
+            "replicas": n_rep, "layers": L, "hidden": H, "heads": NH,
+            "vocab": V, "page_size": page_size, "num_pages": num_pages,
+            "max_batch": max_batch, "n_requests_per_segment": n_req,
+            "round_dt_s": clk.dt, "restart_downtime_rounds": 25,
+            # honesty stamp (r12 discipline): cpu-toy records are
+            # CLI/gate fixtures, not the fleet perf trajectory
+            "geometry": ("cpu-toy" if jax.default_backend() == "cpu"
+                         else jax.default_backend()),
+        },
+    }
+
+
 def bench_attention_varlen():
     """Varlen attention micro-sweep over the reference FMHA seqlens
     {128, 256, 384, 512} at head dim 64 (fmha.py:36-41), ISSUE 5.
@@ -2929,6 +3123,13 @@ def main():
         srv = attempt("serving", bench_serving)
         if srv is not None:
             extras.update(srv)
+
+        # the r16 flagship (ISSUE 16): SLO-aware fleet — aggregate
+        # throughput vs replica count, p99 TTFT through a rolling
+        # restart, zero-compile migration
+        flt = attempt("fleet", bench_fleet)
+        if flt is not None:
+            extras.update(flt)
 
     sidecar = {}
     if not FAST:

@@ -554,6 +554,73 @@ def test_bucket_ms_direction_rule():
     assert key_direction("gpt3d_bucket_bytes") is None
 
 
+def test_regress_fleet_keys_mandatory_on_committed_r16_pair(capsys):
+    """ISSUE 16 satellite: the fleet headline keys are MANDATORY over
+    the committed r16 pair (A = 1 replica, B = 3 replicas; same offered
+    load, virtual-time fleet clock, both cpu-toy self-stamped).  The
+    gate proves the acceptance criteria on committed data: aggregate
+    decode throughput scales with replicas, and the rolling restart's
+    p99 TTFT holds near steady on the fleet while the single replica
+    pays the stop-the-world cost."""
+    a = os.path.join(REPO, "BENCH_r16_fleet.json")
+    b = os.path.join(REPO, "BENCH_r16b_fleet.json")
+    rc = tele_cli(["regress", a, b, "--max-regress", "25", "--json",
+                   "--keys", "fleet_decode_tokens_per_sec,"
+                             "fleet_ttft_p99_restart_ms,"
+                             "fleet_ttft_p99_steady_ms,"
+                             "fleet_dropped"])
+    rec = json.loads(capsys.readouterr().out)
+    assert rc == 0, rec["failures"]
+    by_key = {r["key"]: r for r in rec["rows"]}
+    tok = by_key["fleet_decode_tokens_per_sec"]
+    assert tok["direction"] == "higher" and tok["b"] > tok["a"]
+    p99 = by_key["fleet_ttft_p99_restart_ms"]
+    assert p99["direction"] == "lower" and p99["b"] <= p99["a"]
+    # a drop counter has no "better" direction — reported, never gated
+    assert by_key["fleet_dropped"]["gated"] is False
+    ka, kb = (json.load(open(p)) for p in (a, b))
+    # zero silent drops and zero recompiles after warmup — on BOTH
+    # committed records, the standing contracts in record form
+    for rec_ in (ka, kb):
+        assert rec_["fleet_dropped"] == 0
+        assert rec_["fleet_recompiles_after_warmup"] == 0
+        assert rec_["fleet_config"]["geometry"] == "cpu-toy"
+    # rolling restart HOLDS SLO on the fleet: the restart-segment tail
+    # stays within 25% of steady when peers serve through the downtime
+    # windows...
+    assert kb["fleet_ttft_p99_restart_ms"] \
+        <= 1.25 * kb["fleet_ttft_p99_steady_ms"], (kb,)
+    # ...while the fleet-of-one control pays the full stop-the-world
+    # cost for the same operation (the contrast that makes the fleet
+    # tier worth its complexity)
+    assert ka["fleet_ttft_p99_restart_ms"] \
+        > 1.25 * ka["fleet_ttft_p99_steady_ms"], (ka,)
+    # the restart arc really ran: every replica fenced once, and on
+    # the fleet the live requests moved to peers
+    assert ka["fleet_fences"] == 1 and kb["fleet_fences"] == 3
+    assert kb["fleet_migrations"] > 0
+    # ...and a vanished mandatory key is a failure, not a skip
+    assert tele_cli(["regress", a, b, "--max-regress", "25",
+                     "--keys", "fleet_decode_tokens_per_sec,"
+                               "gone_key"]) == 1
+
+
+def test_fleet_key_direction_rules():
+    """The fleet key families (ISSUE 16) are gated by the explicit
+    family rules — TTFT tails lower-is-better, aggregate throughput
+    higher — while the operational counters stay ungated (a migration
+    or fence count has no universally better direction)."""
+    from apex_tpu.telemetry.regress import key_direction
+
+    assert key_direction("fleet_ttft_p99_restart_ms") == "lower"
+    assert key_direction("fleet_ttft_p99_steady_ms") == "lower"
+    assert key_direction("fleet_decode_tokens_per_sec") == "higher"
+    assert key_direction("fleet_migrations") is None
+    assert key_direction("fleet_fences") is None
+    assert key_direction("fleet_dropped") is None
+    assert key_direction("fleet_restart_wall_s") is None
+
+
 def test_multichip_records_are_geometry_stamped(tmp_path):
     """ISSUE 15 satellite (the ROADMAP maintenance note's last gap):
     every committed MULTICHIP_r*.json self-declares its geometry, and
@@ -572,6 +639,11 @@ def test_multichip_records_are_geometry_stamped(tmp_path):
     assert r15["ok"] is True and r15["geometry"] == "cpu-toy"
     assert "legs=[gpt_3d, chaos_mesh, chaos_data, chaos_serving]" \
         in r15["tail"]
+    # the r16 record adds the serving-fleet migration leg (ISSUE 16)
+    r16 = load_multichip_record(os.path.join(REPO, "MULTICHIP_r16.json"))
+    assert r16["ok"] is True and r16["geometry"] == "cpu-toy"
+    assert "dryrun leg chaos_fleet OK" in r16["tail"]
+    assert "streams=bitwise drops=0" in r16["tail"]
     # refusal controls: unstamped record, non-record file
     p = tmp_path / "unstamped.json"
     p.write_text(json.dumps({"n_devices": 8, "rc": 0, "ok": True,
