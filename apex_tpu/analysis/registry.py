@@ -10,13 +10,22 @@ spec, same collective pattern; only the dimension sizes shrink).  The
 diff its :class:`~apex_tpu.analysis.hlo.ExecutableReport` against the
 committed ``hlo_contracts.json``.
 
-The registry (9 entries):
+The registry (12 entries):
 
 - the serving engine's five compiled shapes (prefill row, decode,
   admission scatter, speculative verify, chunked prefill) — derived
   from :data:`apex_tpu.serving.engine.SERVING_EXECUTABLES`, lowered by
   ``ServingEngine.analysis_executables()`` with the TPU pool donation
   forced on;
+- the r17 tp-sharded serving hot path (``serving_tp_decode`` /
+  ``serving_tp_verify`` / ``serving_tp_chunk``): the same engine at
+  ``tp=2`` over the :data:`~apex_tpu.transformer.parallel_state.
+  TENSOR_AXIS` with the int8 KV pool, so the contract pins BOTH r17
+  artifacts at once — the collective inventory of the sharded decode
+  step (per-block residual ``psum`` all-reduces and nothing else: an
+  unexpected all-gather on the decode hot path is a contract
+  violation) and the quantized pool operands (int8 code planes + f32
+  scale planes as loop carries, donation end-to-end across all four);
 - the dp×tp flagship train step (mesh ``(2, 2, 1)``) — since ISSUE 15
   this is the **bucketed-overlap** ZeRO step at the toy bucket cap
   :data:`FLAGSHIP_BUCKET_BYTES`: the contract pins the ratcheted
@@ -68,6 +77,16 @@ SERVING_ENGINE_TOY = dict(num_pages=24, page_size=16, max_batch=4,
                           prefill_budget=32)
 SERVING_SPEC_K = 2
 SERVING_CHUNK = 16
+
+#: r17 tp-sharded serving geometry: tensor world 2 (the smallest mesh
+#: where the boundary psums appear in the artifact) + the int8 KV
+#: pool, so one extra toy engine covers both new serving modes.
+SERVING_TP = 2
+SERVING_KV_QUANT = "int8"
+#: The tp entries are the HOT PATH only: prefill/admission run once
+#: per request and their tp variants add compile time to every gate
+#: run without pinning anything the decode-path entries don't.
+SERVING_TP_EXECUTABLES = ("decode", "verify", "chunk")
 
 #: Flagship: the test_flagship toy GPT on a dp=2 × tp=2 mesh — the
 #: smallest geometry where the ZeRO scatter/gather AND the tp
@@ -183,6 +202,47 @@ def _register_serving() -> None:
 
 
 _register_serving()
+
+
+@functools.lru_cache(maxsize=1)
+def _toy_engine_tp():
+    from apex_tpu.serving.engine import ServingEngine
+    from apex_tpu.serving.model import ServingModelConfig
+    from apex_tpu.serving.spec import SpecConfig
+    from apex_tpu.transformer.parallel_state import uninitialized_scope
+
+    cfg = ServingModelConfig(**SERVING_TOY)
+    # the contract geometry is pinned at tp=2 over the first two local
+    # devices; an ambient training mesh (e.g. left registered by an
+    # earlier test or a surrounding training process) must not leak
+    # into the lowering, so the engine is built under a hidden state
+    with uninitialized_scope():
+        return ServingEngine(
+            cfg, **SERVING_ENGINE_TOY,
+            spec=SpecConfig(k=SERVING_SPEC_K, chunk_size=SERVING_CHUNK),
+            tp=SERVING_TP, kv_quant=SERVING_KV_QUANT)
+
+
+@functools.lru_cache(maxsize=1)
+def _serving_tp_lowered():
+    # same one-sweep economy as _serving_lowered: three builders, one
+    # engine trace
+    return _toy_engine_tp().analysis_executables()
+
+
+def _serving_tp_builder(exec_name: str):
+    def build():
+        return _serving_tp_lowered()[exec_name]
+    build.__name__ = f"serving_tp_{exec_name}"
+    return build
+
+
+def _register_serving_tp() -> None:
+    for exec_name in SERVING_TP_EXECUTABLES:
+        _REGISTRY[f"serving_tp_{exec_name}"] = _serving_tp_builder(exec_name)
+
+
+_register_serving_tp()
 
 
 def _flagship_lowered(bucket_bytes):

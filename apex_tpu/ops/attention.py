@@ -2161,15 +2161,23 @@ def flash_attention_varlen(
 # ---------------------------------------------------------------------------
 
 
-def _make_decode_kernel(*, scale, page_size, q_len, d):
+def _make_decode_kernel(*, scale, page_size, q_len, d, quantized=False):
     """Decode forward: grid (b, h, p_max); scalar-prefetch operands
     (page_table [b, p_max], kv_len [b]).  Queries are the LAST ``q_len``
     positions of the request's ``kv_len``-token cache (their own k/v
     already appended), so row i's causal limit is column
-    ``kv_len - q_len + i``."""
+    ``kv_len - q_len + i``.
 
-    def kernel(pt_ref, kl_ref, q_ref, k_ref, v_ref, o_ref,
-               m_ref, l_ref, acc_ref):
+    ``quantized`` adds two per-(page, slot, head) fp32 scale operands
+    (blocks [1, page_size, 1]) and dequantizes K/V *in-register* right
+    after the page DMA — the narrow pool bytes are what crosses HBM,
+    the fp32 view never exists outside VMEM (r17)."""
+
+    def kernel(pt_ref, kl_ref, q_ref, k_ref, v_ref, *rest):
+        if quantized:
+            ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+        else:
+            o_ref, m_ref, l_ref, acc_ref = rest
         b_idx = pl.program_id(0)
         p = pl.program_id(2)
         n_p = pl.num_programs(2)
@@ -2187,6 +2195,13 @@ def _make_decode_kernel(*, scale, page_size, q_len, d):
             q = q_ref[0, 0]          # [q_len, d]
             k = k_ref[0, :, 0, :]    # [page_size, d]
             v = v_ref[0, :, 0, :]
+            if quantized:
+                # ks_ref/vs_ref blocks are [1, page_size, 1]; [0] keeps
+                # the trailing unit dim so the multiply broadcasts over
+                # the lane (d) axis without a 1-D reshape
+                q = q.astype(jnp.float32)
+                k = k.astype(jnp.float32) * ks_ref[0]
+                v = v.astype(jnp.float32) * vs_ref[0]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
@@ -2221,24 +2236,38 @@ def _make_decode_kernel(*, scale, page_size, q_len, d):
     return kernel
 
 
-def _flash_decode_pallas(q, k_pages, v_pages, page_table, kv_len, scale):
+def _flash_decode_pallas(q, k_pages, v_pages, page_table, kv_len, scale,
+                         k_scale=None, v_scale=None):
     """q [b, h, q_len, d]; k_pages/v_pages [n_pages, page_size, h, d];
-    page_table [b, p_max] int32 (rows padded with page 0); kv_len [b].
-    Returns o [b, h, q_len, d]."""
+    page_table [b, p_max] int32 (rows padded with page 0); kv_len [b];
+    optional k_scale/v_scale [n_pages, page_size, h] fp32 (quantized
+    pool — dequantized in-kernel).  Returns o [b, h, q_len, d]."""
     b, h, q_len, d = q.shape
     page_size = k_pages.shape[1]
     p_max = page_table.shape[1]
+    quantized = k_scale is not None
+    in_specs = [
+        pl.BlockSpec((1, 1, q_len, d),
+                     lambda bi, hi, p, pt, kl: (bi, hi, 0, 0)),
+        pl.BlockSpec((1, page_size, 1, d),
+                     lambda bi, hi, p, pt, kl: (pt[bi, p], 0, hi, 0)),
+        pl.BlockSpec((1, page_size, 1, d),
+                     lambda bi, hi, p, pt, kl: (pt[bi, p], 0, hi, 0)),
+    ]
+    operands = [q, k_pages, v_pages]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, page_size, 1),
+                         lambda bi, hi, p, pt, kl: (pt[bi, p], 0, hi)),
+            pl.BlockSpec((1, page_size, 1),
+                         lambda bi, hi, p, pt, kl: (pt[bi, p], 0, hi)),
+        ]
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, h, p_max),
-        in_specs=[
-            pl.BlockSpec((1, 1, q_len, d),
-                         lambda bi, hi, p, pt, kl: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, page_size, 1, d),
-                         lambda bi, hi, p, pt, kl: (pt[bi, p], 0, hi, 0)),
-            pl.BlockSpec((1, page_size, 1, d),
-                         lambda bi, hi, p, pt, kl: (pt[bi, p], 0, hi, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, q_len, d),
                                lambda bi, hi, p, pt, kl: (bi, hi, 0, 0)),
         scratch_shapes=[
@@ -2249,26 +2278,33 @@ def _flash_decode_pallas(q, k_pages, v_pages, page_table, kv_len, scale):
     )
     return pl.pallas_call(
         _make_decode_kernel(scale=scale, page_size=page_size,
-                            q_len=q_len, d=d),
+                            q_len=q_len, d=d, quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, h, q_len, d), q.dtype),
         interpret=use_interpret(),
     )(page_table.astype(jnp.int32), kv_len.astype(jnp.int32),
-      q, k_pages, v_pages)
+      *operands)
 
 
-def _paged_attention_xla(q, k_pages, v_pages, page_table, kv_len, scale):
+def _paged_attention_xla(q, k_pages, v_pages, page_table, kv_len, scale,
+                         k_scale=None, v_scale=None):
     """Generic baseline: gather the page list into a contiguous
     [b, p_max*page_size, h, d] KV view in HBM, then plain masked
     attention in fp32 — identical math to the kernel, with the
     materialised gather the kernel exists to avoid.  The decode
     route's ``routing_override`` escape hatch and the parity sweep's
-    reference."""
+    reference.  With ``k_scale``/``v_scale`` [n_pages, page_size, h]
+    the pool is quantized: the gathered bytes are dequantized
+    (``value * scale``, fp32) before scoring — same contraction the
+    Pallas kernel runs in VMEM."""
     b, h, q_len, d = q.shape
     page_size = k_pages.shape[1]
     p_max = page_table.shape[1]
     kc = k_pages[page_table]         # [b, p_max, page_size, h, d]
     vc = v_pages[page_table]
+    if k_scale is not None:
+        kc = kc.astype(jnp.float32) * k_scale[page_table][..., None]
+        vc = vc.astype(jnp.float32) * v_scale[page_table][..., None]
     kc = kc.reshape(b, p_max * page_size, h, d)
     vc = vc.reshape(b, p_max * page_size, h, d)
     s = jnp.einsum("bhqd,bkhd->bhqk", q.astype(jnp.float32),
@@ -2340,6 +2376,8 @@ def flash_decode(
     page_table: jnp.ndarray, kv_len: jnp.ndarray,
     *,
     scale: Optional[float] = None,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Decode-mode attention against a paged KV cache.
 
@@ -2364,19 +2402,33 @@ def flash_decode(
     sequence is shorter than the window must stay finite.  Pinned by
     ``test_kv_len_shorter_than_window_is_exact_zeros``.
 
+    Quantized pool (r17): when ``k_scale``/``v_scale``
+    [n_pages, page_size, h] fp32 are given, ``k_pages``/``v_pages``
+    hold quantized codes (int8 or fp8) and BOTH routes dequantize on
+    read — ``code * scale`` per (page, slot, head), fp32 — so the
+    narrow bytes are what crosses HBM.  Note the shape gate's grain
+    rule is dtype-aware: a one-byte pool needs ``page_size % 32 == 0``
+    for the Pallas route; smaller pages fall back to the XLA route,
+    which runs the identical dequant math.  Scales must come in pairs
+    (both or neither).
+
     Inference-only (no VJP — the serving path never differentiates);
     routing per :func:`flash_decode_route`, forceable via
     ``routing_override(decode=...)``.
     """
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     kv_len = jnp.asarray(kv_len, jnp.int32)
     page_table = jnp.asarray(page_table, jnp.int32)
     if flash_decode_route(q, k_pages) == "decode":
         return _flash_decode_pallas(q, k_pages, v_pages, page_table,
-                                    kv_len, float(scale))
+                                    kv_len, float(scale),
+                                    k_scale=k_scale, v_scale=v_scale)
     return _paged_attention_xla(q, k_pages, v_pages, page_table,
-                                kv_len, float(scale))
+                                kv_len, float(scale),
+                                k_scale=k_scale, v_scale=v_scale)
 
 
 # ---------------------------------------------------------------------------

@@ -21,9 +21,15 @@ Three composable layers, bottom-up:
   subsystem: pluggable :class:`Proposer` drafts
   (:class:`NgramProposer` suffix-cache baseline), exact greedy
   verify-accept at ``q_len = k + 1``, chunked prefill.
+* r17 serving-perf modes, all ``ServingEngine`` knobs: ``tp`` (decode
+  sharded over the parallel_state tensor axis), ``kv_quant``
+  (int8/fp8 pool codes + fp32 scales, quantize-on-write /
+  dequantize-in-kernel), ``prefix_sharing`` (:class:`PrefixIndex` —
+  refcounted copy-on-write pages; repeated prompts pay prefill once).
 
 See docs/serving.md for the page-table layout, the admission policy,
-decode routing, speculative decoding, and the bench methodology.
+decode routing, speculative decoding, prefix sharing, the quantized
+parity bar, and the bench methodology.
 """
 
 from apex_tpu.serving.engine import (  # noqa: F401
@@ -36,11 +42,14 @@ from apex_tpu.serving.kv_cache import (  # noqa: F401
     PagedKVCache,
     PagePoolCorruption,
     PagePoolExhausted,
+    PrefixIndex,
+    quantize_tokens,
 )
 from apex_tpu.serving.model import (  # noqa: F401
     PagedDecoder,
     ServingModelConfig,
     init_params,
+    shard_params_tp,
 )
 from apex_tpu.serving.scheduler import (  # noqa: F401
     FINISHED,
@@ -67,9 +76,12 @@ __all__ = [
     "PagedKVCache",
     "PagePoolCorruption",
     "PagePoolExhausted",
+    "PrefixIndex",
+    "quantize_tokens",
     "PagedDecoder",
     "ServingModelConfig",
     "init_params",
+    "shard_params_tp",
     "ContinuousBatchingScheduler",
     "QueueFullError",
     "Request",

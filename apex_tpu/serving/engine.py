@@ -98,9 +98,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from apex_tpu.serving.kv_cache import (PagedKVCache, PagePoolCorruption)
+from apex_tpu.serving.kv_cache import (PagedKVCache, PagePoolCorruption,
+                                       PrefixIndex)
 from apex_tpu.serving.model import (PagedDecoder, ServingModelConfig,
-                                    init_params)
+                                    init_params, shard_params_tp)
 from apex_tpu.serving.scheduler import (FINISHED, WAITING,
                                         ContinuousBatchingScheduler,
                                         QueueFullError, Request)
@@ -238,7 +239,11 @@ class ServingEngine:
                  recover_on_fault: bool = True,
                  max_recoveries: int = 3,
                  reject_unservable: bool = False,
-                 spec: Optional[SpecConfig] = None):
+                 spec: Optional[SpecConfig] = None,
+                 tp: int = 1,
+                 kv_quant: Optional[str] = None,
+                 prefix_sharing: bool = False,
+                 prefix_entries: int = 8):
         self.cfg = cfg
         self.params = params if params is not None else init_params(cfg, seed)
         self.prefill_budget = (cfg.max_position if prefill_budget is None
@@ -255,6 +260,28 @@ class ServingEngine:
         if self.spec_k > 0:
             self.proposer = (spec.proposer if spec.proposer is not None
                              else NgramProposer())
+        # r17 execution modes (docs/serving.md "Tensor-parallel
+        # serving" / "Quantized KV pool" / "Prefix sharing"):
+        # tp > 1 shards attention heads (and the page pool's head
+        # axis) over the parallel_state tensor axis; kv_quant narrows
+        # the pool to int8/fp8 codes + fp32 per-(page, slot, head)
+        # scales; prefix_sharing admits repeated prompts onto
+        # refcounted shared pages.
+        self.tp = int(tp)
+        self.kv_quant = kv_quant
+        self.prefix_entries = int(prefix_entries)
+        self._mesh = None
+        self._tp_axis = None
+        if self.tp > 1:
+            from apex_tpu.transformer.parallel_state import (
+                TENSOR_AXIS, tensor_parallel_mesh)
+            if cfg.num_heads % self.tp:
+                raise ValueError(
+                    f"num_heads {cfg.num_heads} not divisible by "
+                    f"tp={self.tp}")
+            self._mesh = tensor_parallel_mesh(self.tp)
+            self._tp_axis = TENSOR_AXIS
+            self.params = shard_params_tp(self.params, self.tp)
         if max_pages_per_request is None:
             # a chunked engine serves requests WIDER than the prefill
             # row (that is the point of chunking), so its page-table
@@ -272,13 +299,18 @@ class ServingEngine:
             page_size=page_size, num_heads=cfg.num_heads,
             head_dim=cfg.head_dim,
             max_pages_per_request=max_pages_per_request,
-            dtype=cfg.dtype, crc_pages=validate_pages)
+            dtype=cfg.dtype, crc_pages=validate_pages,
+            quantize=kv_quant)
+        self.prefix_index = (
+            PrefixIndex(self.cache, max_entries=self.prefix_entries)
+            if prefix_sharing else None)
         self.sched = ContinuousBatchingScheduler(
             self.cache, max_batch=max_batch,
             prefill_budget=self.prefill_budget,
             max_position=cfg.max_position,
             max_queue=max_queue, preempt_cap=preempt_cap,
-            chunk_size=self.chunk_size)
+            chunk_size=self.chunk_size,
+            prefix_index=self.prefix_index)
         self.decoder = PagedDecoder(cfg)
         self.max_batch = max_batch
         self.telemetry = telemetry
@@ -298,40 +330,98 @@ class ServingEngine:
         self.steps = 0
         self.decode_steps = 0
         decoder = self.decoder
+        ax = self._tp_axis
+        quant = self.kv_quant is not None
 
         def _prefill(params, tokens, seg, positions, last_index):
             # logits for the last context position only: admission
             # needs one next-token distribution, not S of them
             logits, k, v = decoder.prefill(params, tokens, seg,
-                                           positions, last_index)
+                                           positions, last_index,
+                                           tp_axis=ax)
             return jnp.argmax(logits[0, 0], axis=-1), k[:, 0], v[:, 0]
 
-        def _decode(params, k_pool, v_pool, tokens, positions,
-                    page_table, kv_len):
-            logits, k_pool, v_pool = decoder.decode(
-                params, k_pool, v_pool, tokens, positions, page_table,
-                kv_len)
-            return jnp.argmax(logits, axis=-1), k_pool, v_pool
+        if quant:
+            # quantized pool (r17): the scale planes ride as loop
+            # carries next to the pools — same donation class, rebound
+            # by the engine together with cache.k/v
+            def _decode(params, k_pool, v_pool, k_scale, v_scale,
+                        tokens, positions, page_table, kv_len):
+                (logits, k_pool, v_pool, k_scale,
+                 v_scale) = decoder.decode(
+                    params, k_pool, v_pool, tokens, positions,
+                    page_table, kv_len, k_scale=k_scale,
+                    v_scale=v_scale, tp_axis=ax)
+                return (jnp.argmax(logits, axis=-1), k_pool, v_pool,
+                        k_scale, v_scale)
 
-        def _verify(params, k_pool, v_pool, tokens, positions,
-                    write_pages, write_offsets, page_table, kv_len):
-            # all k+1 positions scored in ONE flash_decode launch;
-            # only the argmax ids leave the device
-            logits, k_pool, v_pool = decoder.extend(
-                params, k_pool, v_pool, tokens, positions,
-                write_pages, write_offsets, page_table, kv_len)
-            return jnp.argmax(logits, axis=-1), k_pool, v_pool
+            def _verify(params, k_pool, v_pool, k_scale, v_scale,
+                        tokens, positions, write_pages, write_offsets,
+                        page_table, kv_len):
+                (logits, k_pool, v_pool, k_scale,
+                 v_scale) = decoder.extend(
+                    params, k_pool, v_pool, tokens, positions,
+                    write_pages, write_offsets, page_table, kv_len,
+                    k_scale=k_scale, v_scale=v_scale, tp_axis=ax)
+                return (jnp.argmax(logits, axis=-1), k_pool, v_pool,
+                        k_scale, v_scale)
 
-        def _chunk(params, k_pool, v_pool, tokens, positions,
-                   write_pages, write_offsets, page_table, kv_len):
-            # one chunk of a long context; front-padding pins the
-            # chunk's last valid token to the final row, so last_only
-            # projects exactly one position through the LM head
-            logits, k_pool, v_pool = decoder.extend(
-                params, k_pool, v_pool, tokens, positions,
-                write_pages, write_offsets, page_table, kv_len,
-                last_only=True)
-            return jnp.argmax(logits[:, 0], axis=-1), k_pool, v_pool
+            def _chunk(params, k_pool, v_pool, k_scale, v_scale,
+                       tokens, positions, write_pages, write_offsets,
+                       page_table, kv_len):
+                (logits, k_pool, v_pool, k_scale,
+                 v_scale) = decoder.extend(
+                    params, k_pool, v_pool, tokens, positions,
+                    write_pages, write_offsets, page_table, kv_len,
+                    last_only=True, k_scale=k_scale, v_scale=v_scale,
+                    tp_axis=ax)
+                return (jnp.argmax(logits[:, 0], axis=-1), k_pool,
+                        v_pool, k_scale, v_scale)
+
+            pool_donate = (1, 2, 3, 4)
+        else:
+            def _decode(params, k_pool, v_pool, tokens, positions,
+                        page_table, kv_len):
+                logits, k_pool, v_pool = decoder.decode(
+                    params, k_pool, v_pool, tokens, positions,
+                    page_table, kv_len, tp_axis=ax)
+                return jnp.argmax(logits, axis=-1), k_pool, v_pool
+
+            def _verify(params, k_pool, v_pool, tokens, positions,
+                        write_pages, write_offsets, page_table, kv_len):
+                # all k+1 positions scored in ONE flash_decode launch;
+                # only the argmax ids leave the device
+                logits, k_pool, v_pool = decoder.extend(
+                    params, k_pool, v_pool, tokens, positions,
+                    write_pages, write_offsets, page_table, kv_len,
+                    tp_axis=ax)
+                return jnp.argmax(logits, axis=-1), k_pool, v_pool
+
+            def _chunk(params, k_pool, v_pool, tokens, positions,
+                       write_pages, write_offsets, page_table, kv_len):
+                # one chunk of a long context; front-padding pins the
+                # chunk's last valid token to the final row, so
+                # last_only projects exactly one position through the
+                # LM head
+                logits, k_pool, v_pool = decoder.extend(
+                    params, k_pool, v_pool, tokens, positions,
+                    write_pages, write_offsets, page_table, kv_len,
+                    last_only=True, tp_axis=ax)
+                return jnp.argmax(logits[:, 0], axis=-1), k_pool, v_pool
+
+            pool_donate = (1, 2)
+
+        if self._mesh is not None:
+            # place params and pools with their tensor-axis shardings
+            # BEFORE anything launches: shard_map pins input shardings,
+            # so an unplaced operand would be resharded INSIDE the
+            # compiled step — a collective the HLO contract forbids on
+            # the decode hot path
+            self.params = jax.device_put(self.params,
+                                         self._param_shardings())
+            self._shard_pools()
+            _prefill, _decode, _verify, _chunk = self._shard_map_execs(
+                _prefill, _decode, _verify, _chunk)
 
         # raw step functions + the donation each SHIPS with on TPU,
         # keyed by compiled-shapes-contract name: the ISSUE 13 checker
@@ -339,22 +429,112 @@ class ServingEngine:
         # spec forced on, so the committed hlo_contracts.json verifies
         # the contract the production backend actually runs under
         self._exec_defs = {"prefill": (_prefill, ()),
-                           "decode": (_decode, (1, 2)),
-                           "verify": (_verify, (1, 2)),
-                           "chunk": (_chunk, (1, 2))}
+                           "decode": (_decode, pool_donate),
+                           "verify": (_verify, pool_donate),
+                           "chunk": (_chunk, pool_donate)}
         self._prefill_fn = jax.jit(_prefill)
         # donate the pool buffers on TPU: the decode step would
         # otherwise hold old + new pool alive across every step (the
         # CPU backend doesn't implement donation — gating avoids a
-        # warning per test run).  The engine rebinds cache.k/v to the
-        # returned pools immediately, so nothing aliases the donated
-        # buffers.
-        donate = (1, 2) if jax.default_backend() == "tpu" else ()
+        # warning per test run).  The engine rebinds cache.k/v (and,
+        # quantized, the scale planes) to the returned pools
+        # immediately, so nothing aliases the donated buffers.
+        donate = pool_donate if jax.default_backend() == "tpu" else ()
         self._decode_fn = jax.jit(_decode, donate_argnums=donate)
         self._verify_fn = (jax.jit(_verify, donate_argnums=donate)
                            if self.spec_k > 0 else None)
         self._chunk_fn = (jax.jit(_chunk, donate_argnums=donate)
                           if self.chunk_size is not None else None)
+
+    # -- tensor-parallel plumbing (r17) ------------------------------------
+
+    def _param_specs(self):
+        """``PartitionSpec`` pytree mirroring the params pytree:
+        wqkv/w1 column-sharded over the tensor axis (each shard owns a
+        head slice — see :func:`~apex_tpu.serving.model.
+        shard_params_tp` for the wqkv column reorder that makes this
+        correct), wo/w2 row-sharded, embeddings / positions / layer
+        norms replicated — the Megatron layout, one ``psum`` per
+        block."""
+        from jax.sharding import PartitionSpec as P
+        ax = self._tp_axis
+        rep = P()
+        ln = {"g": rep, "b": rep}
+        layer = {"ln1": dict(ln), "wqkv": P(None, ax),
+                 "wo": P(ax, None), "ln2": dict(ln),
+                 "w1": P(None, ax), "w2": P(ax, None)}
+        return {"embed": rep, "pos": rep, "ln_f": dict(ln),
+                "layers": [dict(layer)
+                           for _ in range(self.cfg.num_layers)]}
+
+    def _param_shardings(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(self._mesh, s), self._param_specs(),
+            is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+    def _shard_pools(self) -> None:
+        """Place the pool (and scale) arrays on the mesh, sharded on
+        their head axis — fresh pools (init / :meth:`recover`) must be
+        re-placed or the next step would compile a second, resharding
+        executable."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        ax = self._tp_axis
+        pool = NamedSharding(self._mesh, P(None, None, None, ax, None))
+        self.cache.k = jax.device_put(self.cache.k, pool)
+        self.cache.v = jax.device_put(self.cache.v, pool)
+        if self.kv_quant is not None:
+            sc = NamedSharding(self._mesh, P(None, None, None, ax))
+            self.cache.k_scale = jax.device_put(self.cache.k_scale, sc)
+            self.cache.v_scale = jax.device_put(self.cache.v_scale, sc)
+
+    def _shard_map_execs(self, _prefill, _decode, _verify, _chunk):
+        """Wrap the four step bodies in ``shard_map`` over the tensor
+        mesh: pools/scales arrive pre-sharded on their head axis,
+        params per :meth:`_param_specs`, everything else replicated.
+        The bodies derive their head count from the LOCAL shapes and
+        contribute residuals via ``psum`` — the only hot-path
+        collectives, pinned per-executable by the HLO contract."""
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        ax = self._tp_axis
+        pool = P(None, None, None, ax, None)
+        r = P()
+        kv_row = P(None, None, ax, None)
+        pspec = self._param_specs()
+
+        def sm(fn, in_specs, out_specs):
+            return shard_map(fn, mesh=self._mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=False)
+
+        pools = ((pool, pool, P(None, None, None, ax),
+                  P(None, None, None, ax))
+                 if self.kv_quant is not None else (pool, pool))
+        outs = (r,) + pools
+        _prefill = sm(_prefill, (pspec, r, r, r, r), (r, kv_row, kv_row))
+        _decode = sm(_decode, (pspec,) + pools + (r,) * 4, outs)
+        _verify = sm(_verify, (pspec,) + pools + (r,) * 6, outs)
+        _chunk = sm(_chunk, (pspec,) + pools + (r,) * 6, outs)
+        return _prefill, _decode, _verify, _chunk
+
+    # -- quantized-pool plumbing (r17) -------------------------------------
+
+    def _pool_state(self) -> Tuple:
+        """The pool loop-carry operands in executable order —
+        ``(k, v)`` or, quantized, ``(k, v, k_scale, v_scale)``."""
+        if self.kv_quant is not None:
+            return (self.cache.k, self.cache.v,
+                    self.cache.k_scale, self.cache.v_scale)
+        return (self.cache.k, self.cache.v)
+
+    def _bind_pools(self, pools: Tuple) -> None:
+        """Rebind the cache to a step's returned pool carries (the
+        donated-buffer hand-back)."""
+        if self.kv_quant is not None:
+            (self.cache.k, self.cache.v,
+             self.cache.k_scale, self.cache.v_scale) = pools
+        else:
+            self.cache.k, self.cache.v = pools
 
     # -- compiled-artifact exposure (ISSUE 13) -----------------------------
 
@@ -370,22 +550,27 @@ class ServingEngine:
         params = jax.tree_util.tree_map(
             lambda a: sds(jnp.shape(a), a.dtype), self.params)
         pool = sds(self.cache.k.shape, self.cache.k.dtype)
+        pools = (pool, pool)
+        if self.kv_quant is not None:
+            scale = sds(self.cache.k_scale.shape, jnp.float32)
+            pools = (pool, pool, scale, scale)
         S, b = self.prefill_budget, self.max_batch
         p_max = self.cache.max_pages_per_request
         row = sds((1, S), i32)
         out = {
             "prefill": (params, row, row, row, sds((), i32)),
-            "decode": (params, pool, pool, sds((b,), i32), sds((b,), i32),
-                       sds((b, p_max), i32), sds((b,), i32)),
+            "decode": ((params,) + pools
+                       + (sds((b,), i32), sds((b,), i32),
+                          sds((b, p_max), i32), sds((b,), i32))),
         }
         if self._verify_fn is not None:
             q = sds((b, self.spec_k + 1), i32)
-            out["verify"] = (params, pool, pool, q, q, q, q,
-                             sds((b, p_max), i32), sds((b,), i32))
+            out["verify"] = ((params,) + pools + (q, q, q, q,
+                             sds((b, p_max), i32), sds((b,), i32)))
         if self._chunk_fn is not None:
             c = sds((1, self.chunk_size), i32)
-            out["chunk"] = (params, pool, pool, c, c, c, c,
-                            sds((1, p_max), i32), sds((1,), i32))
+            out["chunk"] = ((params,) + pools + (c, c, c, c,
+                            sds((1, p_max), i32), sds((1,), i32)))
         return out
 
     def analysis_executables(self, *, donate: bool = True) -> Dict[str, Any]:
@@ -497,27 +682,32 @@ class ServingEngine:
                                 np.zeros((S,), np.int32))
         b = self.max_batch
         p_max = self.cache.max_pages_per_request
-        _, wk, wv = self._decode_fn(
-            self.params, self.cache.k, self.cache.v,
+        out = self._decode_fn(
+            self.params, *self._pool_state(),
             jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
             jnp.zeros((b, p_max), jnp.int32), jnp.ones((b,), jnp.int32))
-        self.cache.k, self.cache.v = wk, wv
+        self._bind_pools(out[1:])
         if self._verify_fn is not None:
             qw = self.spec_k + 1
             zq = jnp.zeros((b, qw), jnp.int32)
-            _, wk, wv = self._verify_fn(
-                self.params, self.cache.k, self.cache.v, zq, zq, zq, zq,
+            out = self._verify_fn(
+                self.params, *self._pool_state(), zq, zq, zq, zq,
                 jnp.zeros((b, p_max), jnp.int32),
                 jnp.full((b,), qw, jnp.int32))
-            self.cache.k, self.cache.v = wk, wv
+            self._bind_pools(out[1:])
         if self._chunk_fn is not None:
             cs = self.chunk_size
             zc = jnp.zeros((1, cs), jnp.int32)
-            _, wk, wv = self._chunk_fn(
-                self.params, self.cache.k, self.cache.v, zc, zc, zc, zc,
+            out = self._chunk_fn(
+                self.params, *self._pool_state(), zc, zc, zc, zc,
                 jnp.zeros((1, p_max), jnp.int32),
                 jnp.full((1,), cs, jnp.int32))
-            self.cache.k, self.cache.v = wk, wv
+            self._bind_pools(out[1:])
+        if self.prefix_index is not None:
+            # r17: the prefix-sharing engine runs one more executable —
+            # the COW page copy — on the admission path; warm it too so
+            # the first shared-prefix hit compiles nothing
+            self.cache.warm_copy()
         jax.block_until_ready(self.cache.k)
         return time.perf_counter() - t0
 
@@ -562,9 +752,41 @@ class ServingEngine:
         offsets[:C] = idx % ps
         self.cache.write_tokens(k, v, pages, offsets)
         req.kv_len = C
+        self._register_prefix(ctx, req.pages)
         req.generated.append(int(next_tok))
         if req.first_token_t is None:
             req.first_token_t = self.clock()
+
+    def _register_prefix(self, ctx: Sequence[int],
+                         pages: List[int]) -> None:
+        """Register the PAGE-ALIGNED prefix of a freshly prefilled
+        context in the prefix index.  Alignment is deliberate: a
+        partial tail page would be shared while its owner's next
+        decode append still writes into it, forcing a COW on the
+        owner's own hot path — the aligned prefix is immutable by
+        construction (every later write lands at positions
+        ``>= len(ctx) > aligned``)."""
+        if self.prefix_index is None:
+            return
+        ps = self.cache.page_size
+        aligned = (len(ctx) // ps) * ps
+        if aligned >= ps:
+            self.prefix_index.register(ctx[:aligned],
+                                       pages[:aligned // ps])
+
+    def _check_private(self, pages, what: str) -> None:
+        """Write-path guard (r17): a device write targeting a page
+        with refcount > 1 would corrupt another reader's prefix — COW
+        must have swapped in a private copy before the launch.  By
+        construction (aligned registration + admission-time COW) this
+        never fires; it is the cheap host-side proof."""
+        if self.prefix_index is None:
+            return
+        for p in pages:
+            if self.cache.is_shared(int(p)):
+                raise RuntimeError(
+                    f"{what} would write shared page {int(p)} "
+                    "(refcount > 1) — copy-on-write missing")
 
     def _decode_batch(self, rows: List[Request]) -> None:
         """One decode step for ``rows`` (≤ max_batch), idle-padded to
@@ -584,13 +806,15 @@ class ServingEngine:
             positions[i] = req.seq_len - 1
             kv_len[i] = req.seq_len
             written.append(req.pages[(req.seq_len - 1) // ps])
+        self._check_private(written, "decode append")
         page_table = self.cache.page_table(
             [req.pages for req in rows], rows=b)
-        next_tok, k_pool, v_pool = self._decode_fn(
-            self.params, self.cache.k, self.cache.v,
+        out = self._decode_fn(
+            self.params, *self._pool_state(),
             jnp.asarray(tokens), jnp.asarray(positions), page_table,
             jnp.asarray(kv_len))
-        self.cache.k, self.cache.v = k_pool, v_pool
+        next_tok = out[0]
+        self._bind_pools(out[1:])
         self.cache.refresh_page_crcs(written)
         next_tok = np.asarray(next_tok)
         for i, req in enumerate(rows):
@@ -640,14 +864,16 @@ class ServingEngine:
             woffs[i, pad:] = pos % ps
             kv_len[i] = S + j
             written.extend(int(p) for p in pg)
+        self._check_private(written, "verify append")
         page_table = self.cache.page_table(
             [req.pages for req in rows], rows=b)
-        next_tok, k_pool, v_pool = self._verify_fn(
-            self.params, self.cache.k, self.cache.v,
+        out = self._verify_fn(
+            self.params, *self._pool_state(),
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(wpages), jnp.asarray(woffs), page_table,
             jnp.asarray(kv_len))
-        self.cache.k, self.cache.v = k_pool, v_pool
+        next_tok = out[0]
+        self._bind_pools(out[1:])
         self.cache.refresh_page_crcs(written)
         next_tok = np.asarray(next_tok)
         drafted = accepted = committed = 0
@@ -711,13 +937,15 @@ class ServingEngine:
         pg = np.asarray(req.pages, np.int32)[pos // ps]
         wpages[0, pad:] = pg
         woffs[0, pad:] = pos % ps
+        self._check_private(pg, "chunk scatter")
         page_table = self.cache.page_table([req.pages], rows=1)
-        next_tok, k_pool, v_pool = self._chunk_fn(
-            self.params, self.cache.k, self.cache.v,
+        out = self._chunk_fn(
+            self.params, *self._pool_state(),
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(wpages), jnp.asarray(woffs), page_table,
             jnp.asarray(np.full((1,), start + n, np.int32)))
-        self.cache.k, self.cache.v = k_pool, v_pool
+        next_tok = out[0]
+        self._bind_pools(out[1:])
         self.cache.refresh_page_crcs(int(p) for p in pg)
         req.kv_len = start + n
         req.prefill_pos = start + n
@@ -725,6 +953,7 @@ class ServingEngine:
             # prefill complete: sample the first token and leave
             # chunked mode — the request decodes from the next boundary
             req.prefill_pos = None
+            self._register_prefix(ctx, req.pages)
             req.generated.append(int(np.asarray(next_tok)[0]))
             if req.first_token_t is None:
                 req.first_token_t = self.clock()
@@ -827,6 +1056,12 @@ class ServingEngine:
                       pages=len(req.pages), preemptions=req.preemptions)
             if req.prefill_pos is not None:
                 ev["chunked"] = True
+            if self.prefix_index is not None:
+                # a real bool on EVERY admission while sharing is on
+                # (hits and misses both) — the summarize hit-rate needs
+                # the denominator, and optional-means-absent would make
+                # a miss indistinguishable from a sharing-off engine
+                ev["prefix_hit"] = bool(req.prefix_hit)
             self._emit("request_admit", **ev)
             progress = True
         for req, start, n in chunk_plan:
@@ -862,6 +1097,10 @@ class ServingEngine:
                 # the plain q_len=1 decode executable is cheaper
                 self._decode_batch(rows)
                 new_tokens = len(rows)
+            if self.prefix_index is not None:
+                # pages with refcount > 1 right now — the live measure
+                # of how much pool the sharing is actually saving
+                spec_fields["pool_shared_pages"] = self.cache.pages_shared
             self.decode_steps += 1
             # evictions ride the decode_step payload (a preempted
             # request is also visible later: its re-admission's
@@ -1073,7 +1312,20 @@ class ServingEngine:
             page_size=old.page_size, num_heads=self.cfg.num_heads,
             head_dim=self.cfg.head_dim,
             max_pages_per_request=old.max_pages_per_request,
-            dtype=self.cfg.dtype, crc_pages=old.crc_pages)
+            dtype=self.cfg.dtype, crc_pages=old.crc_pages,
+            # the rebuilt pool keeps its quantization mode: re-prefill
+            # re-quantizes deterministically (per-(token, head) scales
+            # are order-independent), so recovery stays output-
+            # invisible at the documented quantized parity bar
+            quantize=self.kv_quant)
+        if self._mesh is not None:
+            self._shard_pools()
+        if self.prefix_index is not None:
+            # the index pointed into the dead pool; rebuild it EMPTY —
+            # shared prefixes re-register as re-admissions complete
+            # (warm-cache opportunism is rebuildable, like KV)
+            self.prefix_index = PrefixIndex(
+                self.cache, max_entries=self.prefix_entries)
         sched = ContinuousBatchingScheduler(
             self.cache, max_batch=self.max_batch,
             prefill_budget=self.prefill_budget,
@@ -1085,7 +1337,8 @@ class ServingEngine:
             # context exceeds the prefill row — schedule_prefill could
             # never re-admit it, and FIFO admission would starve
             # everything queued behind it (review-found, pinned)
-            chunk_size=self.chunk_size)
+            chunk_size=self.chunk_size,
+            prefix_index=self.prefix_index)
         sched.finished = self.sched.finished   # history survives
         self.sched = sched
         for req in running:
@@ -1102,9 +1355,15 @@ class ServingEngine:
                 req.state = WAITING
                 sched.waiting.append(req)
         sched.waiting.extend(waiting)
-        # re-place the params on the (rebuilt) device; the two jitted
-        # executables are shape-keyed and survive as-is
-        self.params = jax.device_put(self.params)
+        # re-place the params on the (rebuilt) device; the jitted
+        # executables are shape-keyed and survive as-is.  Under tp the
+        # re-placement must restore the tensor-axis shardings, or the
+        # next step would compile a resharding variant.
+        if self._mesh is not None:
+            self.params = jax.device_put(self.params,
+                                         self._param_shardings())
+        else:
+            self.params = jax.device_put(self.params)
         self.recoveries += 1
         self._emit("serving_recovery", cause=cause, pool_rebuilt=True,
                    running_restored=len(running),

@@ -18,20 +18,30 @@ of fixed-size pages:
   padding positions there.  Readers never see its content (the decode
   kernel and the XLA baseline both mask columns past ``kv_len``), so
   duplicate pad writes landing in it are harmless by construction.
-* Host-side accounting (free list, per-page owner) is plain python —
-  allocation is LOWEST-INDEX-FIRST so every run of the scheduler is
-  bit-reproducible.
+* Host-side accounting (free list, per-page owner, per-page REFCOUNT)
+  is plain python — allocation is LOWEST-INDEX-FIRST so every run of
+  the scheduler is bit-reproducible.
+* r17 adds two orthogonal pool modes: **prefix sharing** (pages are
+  refcounted; N requests whose prompts share a prefix reference the
+  same physical pages, a write to a shared page copies it first —
+  copy-on-write — and ``free`` only returns a page at refcount zero)
+  and a **quantized pool** (``quantize="int8"``/``"fp8"``: the pool
+  holds narrow codes plus per-(page, slot, head) fp32 scales;
+  quantize-on-write in the scatter, dequantize-on-read in
+  ``flash_decode``).
 
 The device arrays are functionally updated (``.at[].set``); the cache
-object re-binds them, so callers treat ``cache.k``/``cache.v`` as the
-current pool state (and may thread them through ``jax.jit`` as loop
-carries).
+object re-binds them, so callers treat ``cache.k``/``cache.v`` (and,
+quantized, ``cache.k_scale``/``cache.v_scale``) as the current pool
+state (and may thread them through ``jax.jit`` as loop carries).
 """
 
 from __future__ import annotations
 
 import bisect
+import functools
 import zlib
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -42,6 +52,67 @@ import numpy as np
 def _scatter_tokens(k_pool, v_pool, k_new, v_new, pages, offsets):
     return (k_pool.at[:, pages, offsets].set(k_new),
             v_pool.at[:, pages, offsets].set(v_new))
+
+
+#: qmax per quantization mode: int8 symmetric [-127, 127] (the -128
+#: code is unused so the grid is symmetric), fp8 e4m3 saturates at 448.
+_QUANT_QMAX = {"int8": 127.0, "fp8": 448.0}
+
+
+def quant_pool_dtype(mode: str):
+    """Device dtype of the quantized pool's code arrays."""
+    if mode == "int8":
+        return jnp.int8
+    if mode == "fp8":
+        dt = getattr(jnp, "float8_e4m3fn", None)
+        if dt is None:
+            raise ValueError(
+                "quantize='fp8' needs jnp.float8_e4m3fn, which this "
+                "jax build lacks — use quantize='int8'")
+        return dt
+    raise ValueError(f"unknown quantize mode {mode!r} "
+                     f"(expected one of {sorted(_QUANT_QMAX)})")
+
+
+def quantize_tokens(x: jnp.ndarray, qdtype, qmax: float):
+    """``x`` [..., H, D] -> (codes [..., H, D] ``qdtype``, scale
+    [..., H] fp32).
+
+    The scale is a PURE per-(token, head) function of that token's own
+    values — absmax over D divided by ``qmax``, with absmax 0 mapped to
+    scale 1 so zero rows stay exactly zero.  Order independence is the
+    point: quantizing a token during incremental decode append and
+    re-quantizing it during a bulk rebuild prefill produce
+    bitwise-identical pool bytes, which is what lets the KV-rebuild
+    recovery contract extend to the quantized pool.
+    """
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(absmax == 0.0, 1.0, absmax / qmax)
+    codes = xf / scale[..., None]
+    if np.dtype(qdtype) == np.dtype(np.int8):
+        codes = jnp.clip(jnp.round(codes), -qmax, qmax)
+    return codes.astype(qdtype), scale
+
+
+def _scatter_tokens_quant(k_pool, v_pool, ks_pool, vs_pool,
+                          k_new, v_new, pages, offsets, *, qmax):
+    """Quantize-on-write admission scatter: incoming fp tokens are
+    narrowed on device (codes + scales) and scattered in one fused
+    update per pool array — the wide values never land in HBM."""
+    kq, ks = quantize_tokens(k_new, k_pool.dtype, qmax)
+    vq, vs = quantize_tokens(v_new, v_pool.dtype, qmax)
+    return (k_pool.at[:, pages, offsets].set(kq),
+            v_pool.at[:, pages, offsets].set(vq),
+            ks_pool.at[:, pages, offsets].set(ks),
+            vs_pool.at[:, pages, offsets].set(vs))
+
+
+def _copy_page(pool, src, dst):
+    """pool[:, dst] = pool[:, src] with traced indices, so every COW
+    copy reuses one compiled executable regardless of page ids."""
+    page = jax.lax.dynamic_index_in_dim(pool, src, axis=1, keepdims=True)
+    return jax.lax.dynamic_update_slice_in_dim(pool, page, dst, axis=1)
 
 
 class PagePoolExhausted(RuntimeError):
@@ -69,7 +140,8 @@ class PagedKVCache:
     def __init__(self, *, num_layers: int, num_pages: int,
                  page_size: int, num_heads: int, head_dim: int,
                  max_pages_per_request: int,
-                 dtype=jnp.float32, crc_pages: bool = False):
+                 dtype=jnp.float32, crc_pages: bool = False,
+                 quantize: Optional[str] = None):
         if num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is the "
                              "reserved scratch page)")
@@ -83,17 +155,42 @@ class PagedKVCache:
         self.num_heads = num_heads
         self.head_dim = head_dim
         self.max_pages_per_request = max_pages_per_request
+        #: quantization mode (None / "int8" / "fp8").  ``dtype`` stays
+        #: the COMPUTE dtype of the tokens fed to ``write_tokens``;
+        #: quantized pools store narrow codes plus fp32 scales.
+        self.quantize = quantize
+        self.dtype = dtype
+        pool_dtype = quant_pool_dtype(quantize) if quantize else dtype
         shape = (num_layers, num_pages, page_size, num_heads, head_dim)
-        self.k = jnp.zeros(shape, dtype)
-        self.v = jnp.zeros(shape, dtype)
+        self.k = jnp.zeros(shape, pool_dtype)
+        self.v = jnp.zeros(shape, pool_dtype)
         # the prefill scatter donates the old pool on TPU so the
         # update is in-place — two full-pool copies per admission
         # would otherwise sit on the TTFT-critical path
-        donate = (0, 1) if jax.default_backend() == "tpu" else ()
-        self._scatter = jax.jit(_scatter_tokens, donate_argnums=donate)
+        if quantize:
+            self.qmax = _QUANT_QMAX[quantize]
+            sshape = (num_layers, num_pages, page_size, num_heads)
+            self.k_scale = jnp.zeros(sshape, jnp.float32)
+            self.v_scale = jnp.zeros(sshape, jnp.float32)
+            donate = (0, 1, 2, 3) if jax.default_backend() == "tpu" else ()
+            self._scatter = jax.jit(
+                functools.partial(_scatter_tokens_quant, qmax=self.qmax),
+                donate_argnums=donate)
+        else:
+            self.qmax = None
+            self.k_scale = self.v_scale = None
+            donate = (0, 1) if jax.default_backend() == "tpu" else ()
+            self._scatter = jax.jit(_scatter_tokens, donate_argnums=donate)
+        self._copy = jax.jit(
+            _copy_page,
+            donate_argnums=(0,) if jax.default_backend() == "tpu" else ())
         # sorted free list, lowest-first allocation: deterministic
         self._free: List[int] = list(range(1, num_pages))
         self._owner: Dict[int, int] = {}
+        # per-page refcount (r17 prefix sharing): every allocated page
+        # has exactly one entry; allocate -> 1, share -> +1, free -> -1
+        # with the page returning to the free list only at zero
+        self._ref: Dict[int, int] = {}
         # opt-in per-page CRC validation (ISSUE 10): every host-visible
         # write records a crc32 of the page's K and V bytes;
         # verify_pages re-reads the device content and raises
@@ -113,13 +210,20 @@ class PagedKVCache:
     def pages_used(self) -> int:
         return (self.num_pages - 1) - len(self._free)
 
+    @property
+    def pages_shared(self) -> int:
+        """Pages currently referenced by MORE than one reader (live
+        requests and/or the prefix index) — the ``pool_shared_pages``
+        telemetry count."""
+        return sum(1 for r in self._ref.values() if r > 1)
+
     def pages_needed(self, n_tokens: int) -> int:
         return -(-n_tokens // self.page_size)  # ceil
 
     def allocate(self, n: int, owner: int) -> List[int]:
-        """Take ``n`` free pages for ``owner`` (a request id); raises
-        :class:`PagePoolExhausted` — with the pool untouched — when
-        fewer than ``n`` are free."""
+        """Take ``n`` free pages for ``owner`` (a request id) at
+        refcount 1; raises :class:`PagePoolExhausted` — with the pool
+        untouched — when fewer than ``n`` are free."""
         if n > len(self._free):
             raise PagePoolExhausted(
                 f"need {n} pages, {len(self._free)} free "
@@ -127,16 +231,72 @@ class PagedKVCache:
         pages, self._free = self._free[:n], self._free[n:]
         for p in pages:
             self._owner[p] = owner
+            self._ref[p] = 1
         return pages
 
-    def free(self, pages: Sequence[int]) -> None:
-        """Return pages to the pool (retirement or preemption).  The
-        page CONTENT is left in place — readers mask by ``kv_len``, so
-        stale values are unreachable, and skipping the zero-fill keeps
-        retirement free."""
+    def share(self, pages: Sequence[int]) -> None:
+        """Add one reader to each page (prefix sharing): the pages'
+        CONTENT becomes immutable until the refcount drops back —
+        writers must :meth:`cow` first.  Raises on pages that are not
+        currently allocated (sharing a free page would resurrect it)."""
         for p in pages:
-            if p == 0 or p in self._free:
+            if p == 0 or p not in self._ref:
+                raise ValueError(f"share of unallocated page {p}")
+        for p in pages:
+            self._ref[p] += 1
+
+    def refcount(self, page: int) -> int:
+        return self._ref.get(page, 0)
+
+    def is_shared(self, page: int) -> bool:
+        """True while more than one reader references ``page`` — the
+        state in which writes (scatter/append), :meth:`free_tail` and
+        :meth:`defrag` are forbidden on it (docs/serving.md
+        "Prefix sharing")."""
+        return self._ref.get(page, 0) > 1
+
+    def cow(self, page: int, owner: int) -> int:
+        """Copy-on-write: give ``owner`` a private copy of shared
+        ``page`` and drop its own reference to the original.  Returns
+        the new page id; the caller swaps it into its page list before
+        writing.  Content (K, V and — quantized — the scale planes)
+        moves by one compiled dynamic-slice copy per pool array, so
+        repeated COWs never recompile.  Raises on an unshared page
+        (a private page needs no copy — calling this would leak one)
+        and propagates :class:`PagePoolExhausted` when no page is free
+        (an ordinary scheduling event, like any allocation failure)."""
+        if self._ref.get(page, 0) < 2:
+            raise ValueError(f"cow on unshared page {page} "
+                             f"(refcount {self._ref.get(page, 0)})")
+        [new] = self.allocate(1, owner)
+        src = jnp.int32(page)
+        dst = jnp.int32(new)
+        self.k = self._copy(self.k, src, dst)
+        self.v = self._copy(self.v, src, dst)
+        if self.quantize:
+            self.k_scale = self._copy(self.k_scale, src, dst)
+            self.v_scale = self._copy(self.v_scale, src, dst)
+        self._ref[page] -= 1
+        # content moved verbatim, so the copy inherits the digest
+        if page in self._crc:
+            self._crc[new] = self._crc[page]
+        return new
+
+    def free(self, pages: Sequence[int]) -> None:
+        """Drop one reference per page; a page returns to the pool
+        (retirement or preemption) only when its refcount reaches zero
+        — while the prefix index or another request still references
+        it, the page stays live.  The freed page's CONTENT is left in
+        place — readers mask by ``kv_len``, so stale values are
+        unreachable, and skipping the zero-fill keeps retirement
+        free."""
+        for p in pages:
+            if p == 0 or p not in self._ref:
                 raise ValueError(f"double free / scratch free: page {p}")
+            self._ref[p] -= 1
+            if self._ref[p] > 0:
+                continue
+            del self._ref[p]
             self._owner.pop(p, None)
             self._crc.pop(p, None)
             bisect.insort(self._free, p)
@@ -147,10 +307,20 @@ class PagedKVCache:
         tail rows were rejected are returned to the pool, and the
         request's page list is truncated to the committed footprint.
         A ``keep`` at or past the list length is a no-op (a fully
-        accepted draft rolls back nothing)."""
+        accepted draft rolls back nothing).
+
+        FORBIDDEN on shared pages (r17): draft tails only ever live in
+        pages the request grew privately past its prompt, so a shared
+        page in the tail means the rollback arithmetic is wrong —
+        raising beats silently dropping another reader's prefix."""
         if keep < 0:
             raise ValueError(f"free_tail keep={keep} must be >= 0")
         tail = pages[keep:]
+        shared = [p for p in tail if self.is_shared(p)]
+        if shared:
+            raise ValueError(
+                f"free_tail would roll back shared page(s) {shared} — "
+                "rollback is only defined on a request's private tail")
         if tail:
             self.free(tail)
             del pages[keep:]
@@ -180,17 +350,40 @@ class PagedKVCache:
                      pages: jnp.ndarray, offsets: jnp.ndarray) -> None:
         """Scatter per-token K/V into the pool (the prefill fill path).
 
-        ``k_new``/``v_new``: ``[num_layers, T, num_heads, head_dim]``;
-        token t lands in ``(pages[t], offsets[t])``.  Padding positions
-        point at the scratch page 0."""
+        ``k_new``/``v_new``: ``[num_layers, T, num_heads, head_dim]``
+        in the COMPUTE dtype; token t lands in ``(pages[t],
+        offsets[t])``.  Padding positions point at the scratch page 0.
+        Quantized pools quantize-on-write: codes and per-(slot, head)
+        scales are produced on device and scattered together."""
         touched = ({int(p) for p in np.asarray(pages).ravel()} - {0}
                    if self.crc_pages else ())
         pages = jnp.asarray(pages, jnp.int32)
         offsets = jnp.asarray(offsets, jnp.int32)
-        self.k, self.v = self._scatter(
-            self.k, self.v, k_new, v_new, pages, offsets)
+        if self.quantize:
+            self.k, self.v, self.k_scale, self.v_scale = self._scatter(
+                self.k, self.v, self.k_scale, self.v_scale,
+                k_new, v_new, pages, offsets)
+        else:
+            self.k, self.v = self._scatter(
+                self.k, self.v, k_new, v_new, pages, offsets)
         if self.crc_pages:
             self.refresh_page_crcs(touched)
+
+    def warm_copy(self) -> None:
+        """Compile the COW page-copy executable (:meth:`cow`'s
+        ``_copy_page``) against the live pool shapes — scratch page 0
+        copied onto itself, a content no-op no reader ever sees — so
+        the first shared-prefix admission's copy-on-write never pays a
+        jit compile on the admission path.  Quantized pools warm the
+        scale-plane shape too (same function, second specialization).
+        Called from ``ServingEngine.warmup`` when prefix sharing is
+        on; part of the zero-compiles-after-warmup contract."""
+        z = jnp.int32(0)
+        self.k = self._copy(self.k, z, z)
+        self.v = self._copy(self.v, z, z)
+        if self.quantize:
+            self.k_scale = self._copy(self.k_scale, z, z)
+            self.v_scale = self._copy(self.v_scale, z, z)
 
     def analysis_executable(self, n_tokens: int, *, donate: bool = True):
         """``jax.stages.Lowered`` of the :meth:`write_tokens` scatter
@@ -199,12 +392,21 @@ class PagedKVCache:
         checker verifies the donation the shipped engine relies on (an
         undonated scatter copies BOTH full pools per admission on the
         TTFT-critical path: the PR 8 768 MB lesson).  ``donate=False``
-        is the checker's negative control."""
+        is the checker's negative control.  A quantized cache lowers
+        the quantize-on-write variant with the scale planes donated
+        too (params 0-3 alias outputs 0-3)."""
         sds = jax.ShapeDtypeStruct
         pool = sds(self.k.shape, self.k.dtype)
         new = sds((self.num_layers, n_tokens, self.num_heads,
-                   self.head_dim), self.k.dtype)
+                   self.head_dim), self.dtype)
         idx = sds((n_tokens,), jnp.int32)
+        if self.quantize:
+            scale = sds(self.k_scale.shape, jnp.float32)
+            jitted = jax.jit(
+                functools.partial(_scatter_tokens_quant, qmax=self.qmax),
+                donate_argnums=(0, 1, 2, 3) if donate else ())
+            return jitted.lower(pool, pool, scale, scale, new, new,
+                                idx, idx)
         jitted = jax.jit(_scatter_tokens,
                          donate_argnums=(0, 1) if donate else ())
         return jitted.lower(pool, pool, new, new, idx, idx)
@@ -212,10 +414,18 @@ class PagedKVCache:
     # -- per-page CRC validation (ISSUE 10, opt-in) ----------------------
 
     def _page_digest(self, page: int) -> Tuple[int, int]:
-        """crc32 of page ``page``'s K and V bytes across all layers."""
+        """crc32 of page ``page``'s K and V bytes across all layers
+        (quantized: codes AND scale planes — content identity includes
+        the scales, or a flipped scale bit would read back clean)."""
         k = np.ascontiguousarray(np.asarray(self.k[:, page]))
         v = np.ascontiguousarray(np.asarray(self.v[:, page]))
-        return (zlib.crc32(k.tobytes()), zlib.crc32(v.tobytes()))
+        kb, vb = k.tobytes(), v.tobytes()
+        if self.quantize:
+            # same sanctioned read-back as the code planes above —
+            # device ``.tobytes()`` pulls the scale slice directly
+            kb += self.k_scale[:, page].tobytes()
+            vb += self.v_scale[:, page].tobytes()
+        return (zlib.crc32(kb), zlib.crc32(vb))
 
     def refresh_page_crcs(self, pages: Sequence[int]) -> None:
         """Re-record CRCs after a host-visible write (prefill scatter /
@@ -253,7 +463,25 @@ class PagedKVCache:
         "occupancy == high-water-mark" invariant).  ``page_lists`` are
         the page lists of every live request, IN PLACE — they are
         rewritten to the new ids.  Returns the old→new mapping.
-        Content moves by one device gather per pool array."""
+        Content moves by one device gather per pool array (quantized:
+        the scale planes gather with the codes).
+
+        FORBIDDEN while any page is shared (r17): under prefix sharing
+        one physical page legitimately appears in several page lists,
+        which breaks both the overlap check below (duplicates are no
+        longer proof of corruption) and the dense-renumber arithmetic
+        (a shared page would need ONE new id visible to every reader,
+        including the prefix index's entries, which this method never
+        sees).  Callers drain sharing first — evict the prefix index
+        and wait for multi-reader pages to drop to refcount 1 — or
+        skip the compaction; a pool with live sharing is by definition
+        not fragmented enough to need it."""
+        shared = sorted(p for p, r in self._ref.items() if r > 1)
+        if shared:
+            raise ValueError(
+                f"defrag forbidden while page(s) {shared} are shared "
+                "(refcount > 1) — evict the prefix index / let readers "
+                "retire first")
         live: List[int] = []
         for pages in page_lists:
             live.extend(pages)
@@ -268,8 +496,13 @@ class PagedKVCache:
         src_j = jnp.asarray(src, jnp.int32)
         self.k = self.k[:, src_j]
         self.v = self.v[:, src_j]
+        if self.quantize:
+            self.k_scale = self.k_scale[:, src_j]
+            self.v_scale = self.v_scale[:, src_j]
         self._owner = {mapping[p]: o for p, o in self._owner.items()
                        if p in mapping}
+        self._ref = {mapping[p]: r for p, r in self._ref.items()
+                     if p in mapping}
         # content moves verbatim with the ids, so digests remap too
         self._crc = {mapping[p]: c for p, c in self._crc.items()
                      if p in mapping}
@@ -277,3 +510,107 @@ class PagedKVCache:
         for pages in page_lists:
             pages[:] = [mapping[p] for p in pages]
         return mapping
+
+
+class PrefixIndex:
+    """Prompt-prefix registry backing page sharing (r17).
+
+    Maps a previously prefilled context (token tuple) to the pages
+    holding its K/V, taking its OWN +1 refcount on every registered
+    page (``PagedKVCache.share``) so an entry outlives the request
+    that built it — a popular system prompt stays warm in the pool
+    after every request using it has retired.
+
+    Admission asks :meth:`lookup` for the longest registered prefix of
+    a new request's context; on a hit the scheduler shares those pages
+    (prefill for the covered tokens is SKIPPED — the new request
+    chunk-prefills only its suffix against the shared pages).  The
+    shared coverage is capped at ``len(context) - 1`` tokens so every
+    admitted request still computes at least its final prompt token —
+    that chunk is what yields the first-token logits.
+
+    Capacity is bounded (``max_entries``); eviction is OLDEST-FIRST
+    (insertion order — deterministic, like every other scheduling
+    decision here) and only drops the INDEX's reference: a page some
+    live request still reads keeps a nonzero refcount and never
+    returns to the free list (pinned by the r17 eviction test).
+    """
+
+    def __init__(self, cache: PagedKVCache, *, max_entries: int = 8):
+        if max_entries < 1:
+            raise ValueError("max_entries must be >= 1")
+        self.cache = cache
+        self.max_entries = int(max_entries)
+        self._entries: "OrderedDict[Tuple[int, ...], List[int]]" = \
+            OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def entries(self) -> List[Tuple[int, ...]]:
+        return list(self._entries)
+
+    def register(self, tokens: Sequence[int],
+                 pages: Sequence[int]) -> bool:
+        """Register a completed prefill's context -> page-list mapping
+        (the request KEEPS its own references; the index adds one per
+        page).  Rejects contexts shorter than one page (nothing to
+        share) and duplicate keys; enforces that ``pages`` is exactly
+        the context's page footprint, no more — registering a
+        request's decode-grown tail would share pages it is still
+        writing."""
+        key = tuple(int(t) for t in tokens)
+        if len(key) < self.cache.page_size or key in self._entries:
+            return False
+        if len(pages) != self.cache.pages_needed(len(key)):
+            raise ValueError(
+                f"register: {len(pages)} pages for a {len(key)}-token "
+                f"context (expected {self.cache.pages_needed(len(key))})")
+        self.cache.share(pages)
+        self._entries[key] = list(pages)
+        while len(self._entries) > self.max_entries:
+            self.evict_one()
+        return True
+
+    def lookup(self, tokens: Sequence[int]) -> Tuple[int, List[int]]:
+        """Longest usable shared prefix for ``tokens``: returns
+        ``(m, pages)`` where the first ``m`` context tokens are covered
+        by ``pages`` (the entry's leading ``ceil(m / page_size)``
+        pages), or ``(0, [])`` on a miss.  ``m`` is capped at
+        ``len(tokens) - 1`` (see class docstring) and hits below one
+        full page are ignored.  When ``m`` ends mid-page the last
+        shared page also holds the ENTRY's diverging tokens past ``m``
+        — safe, because readers mask by their own ``kv_len`` and the
+        new reader's first write into that page copies it first
+        (copy-on-write)."""
+        ctx = tuple(int(t) for t in tokens)
+        best_m, best_pages = 0, []
+        for key, pages in self._entries.items():
+            lim = min(len(key), len(ctx) - 1)
+            m = 0
+            while m < lim and key[m] == ctx[m]:
+                m += 1
+            if m >= self.cache.page_size and m > best_m:
+                best_m = m
+                best_pages = pages[:self.cache.pages_needed(m)]
+        return best_m, list(best_pages)
+
+    def evict_one(self) -> int:
+        """Drop the oldest entry, releasing the index's reference on
+        its pages; returns how many pages actually went back to the
+        free list (pages another reader still holds stay live — the
+        index can never free a page out from under a request)."""
+        if not self._entries:
+            return 0
+        _, pages = self._entries.popitem(last=False)
+        before = self.cache.pages_free
+        self.cache.free(pages)
+        return self.cache.pages_free - before
+
+    def clear(self) -> int:
+        """Evict every entry; returns pages returned to the pool."""
+        freed = 0
+        while self._entries:
+            freed += self.evict_one()
+        return freed

@@ -44,6 +44,28 @@ continuous decoding produce bit-identical tokens to one-request-at-a-
 time decoding — the scheduler composes batches freely without
 perturbing anyone's output.
 
+r17 adds two orthogonal execution modes, both threaded through the
+same three methods:
+
+* **Tensor parallelism** (``tp_axis=...``): the methods are written to
+  run INSIDE ``shard_map`` over a mesh axis, Megatron-style — wqkv/w1
+  column-sharded (each shard owns a head slice; see
+  :func:`shard_params_tp` for the wqkv column reorder that keeps the
+  in-method ``jnp.split`` correct), wo/w2 row-sharded, embeddings and
+  layer norms replicated.  The head count is derived from the LOCAL
+  shard shapes, the paged pool shards on its head axis, and each
+  block contributes its partial residual via ONE ``lax.psum`` — the
+  only collectives on the decode hot path (pinned by the HLO
+  contract registry).  Note batched==sequential stays bitwise WITHIN
+  a tp config (same executable, same reduction grouping); tp=1 vs
+  tp=2 outputs differ at the last ulp like any re-grouped reduction.
+* **Quantized pool** (``k_scale``/``v_scale`` given): appends
+  quantize-on-write (:func:`~apex_tpu.serving.kv_cache.
+  quantize_tokens` — per-(token, head) scales, order-independent) and
+  reads dequantize-in-kernel via ``flash_decode``'s scale operands.
+  Scales shard on their head axis exactly like the pool, so the two
+  modes compose with no extra collectives.
+
 The parameter layout is a plain pytree (:func:`init_params`) with tied
 embeddings; fp32 by default (the serving tests pin bitwise claims),
 bf16 for TPU throughput via ``ServingModelConfig(dtype=...)``.
@@ -57,8 +79,50 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from apex_tpu.ops import flash_attention, flash_decode
+from apex_tpu.serving.kv_cache import quantize_tokens
+
+
+def quant_qmax(dtype) -> float:
+    """qmax for a quantized pool's code dtype (int8 -> 127, fp8 e4m3
+    -> 448) — lets the model derive the grid from the pool it is
+    handed instead of carrying a second config knob."""
+    if np.dtype(dtype) == np.dtype(np.int8):
+        return 127.0
+    return 448.0
+
+
+def shard_params_tp(params, tp: int):
+    """Reorder each layer's fused ``wqkv`` [h, 3h] into SHARD-MAJOR
+    column blocks ``[q_0|k_0|v_0 | q_1|k_1|v_1 | ...]`` so that
+    column-sharding it over ``tp`` devices hands shard j exactly its
+    head slice of all three projections — the in-method
+    ``jnp.split(qkv, 3, -1)`` then works unchanged on the local block.
+    Plain column sharding of the unreordered fusion would give shard 0
+    a slab of pure-q columns instead.  Returns a NEW pytree (host-side
+    numpy reorder, done once at engine init); ``tp=1`` returns the
+    params untouched."""
+    if tp == 1:
+        return params
+    out = dict(params)
+    out["layers"] = []
+    for layer in params["layers"]:
+        w = np.asarray(layer["wqkv"])
+        h = w.shape[0]
+        if h % tp:
+            raise ValueError(f"hidden_size {h} not divisible by tp={tp}")
+        wq, wk, wv = np.split(w, 3, axis=1)
+        blocks = []
+        for j in range(tp):
+            sl = slice(j * h // tp, (j + 1) * h // tp)
+            blocks += [wq[:, sl], wk[:, sl], wv[:, sl]]
+        new = dict(layer)
+        new["wqkv"] = jnp.asarray(np.concatenate(blocks, axis=1),
+                                  w.dtype)
+        out["layers"].append(new)
+    return out
 
 
 @dataclasses.dataclass(frozen=True)
@@ -134,6 +198,7 @@ class PagedDecoder:
     def prefill(self, params, tokens: jnp.ndarray, seg: jnp.ndarray,
                 positions: jnp.ndarray,
                 last_index: Optional[jnp.ndarray] = None,
+                *, tp_axis: Optional[str] = None,
                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """tokens/seg/positions ``[1, S]`` (one packed row; seg 0 =
         padding, real segments 1..n; positions restart per segment).
@@ -147,9 +212,14 @@ class PagedDecoder:
         rows through the LM head would put an S×hidden×vocab matmul on
         the TTFT-critical path for one useful row.  ``None`` returns
         the full ``[1, S, vocab]`` logits (teacher-forcing/scoring
-        use)."""
+        use).
+
+        ``tp_axis``: run as the per-shard body under ``shard_map`` —
+        the local wqkv block carries this shard's heads (the returned
+        k/v are the LOCAL head slice) and each block's residual is
+        one ``psum``."""
         cfg = self.cfg
-        hd, nh = cfg.head_dim, cfg.num_heads
+        hd = cfg.head_dim
         x = params["embed"][tokens] + params["pos"][positions]
         ks, vs = [], []
         for layer in params["layers"]:
@@ -157,14 +227,21 @@ class PagedDecoder:
             qkv = hdn @ layer["wqkv"]
             q, k, v = jnp.split(qkv, 3, axis=-1)
             b, s = q.shape[:2]
+            nh = k.shape[-1] // hd  # LOCAL heads (H/tp under shard_map)
             q4 = q.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
             k4 = k.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
             v4 = v.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
             ctx = flash_attention(q4, k4, v4, causal=True,
                                   segment_ids=seg)
             ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, -1)
-            x = x + ctx @ layer["wo"]
-            x = x + _mlp(_ln(x, layer["ln2"]), layer)
+            attn = ctx @ layer["wo"]
+            if tp_axis is not None:
+                attn = jax.lax.psum(attn, tp_axis)
+            x = x + attn
+            mlp = _mlp(_ln(x, layer["ln2"]), layer)
+            if tp_axis is not None:
+                mlp = jax.lax.psum(mlp, tp_axis)
+            x = x + mlp
             ks.append(k.reshape(b, s, nh, hd))
             vs.append(v.reshape(b, s, nh, hd))
         x = _ln(x, params["ln_f"])
@@ -178,7 +255,10 @@ class PagedDecoder:
 
     def decode(self, params, k_pool, v_pool, tokens: jnp.ndarray,
                positions: jnp.ndarray, page_table: jnp.ndarray,
-               kv_len: jnp.ndarray):
+               kv_len: jnp.ndarray, *,
+               k_scale: Optional[jnp.ndarray] = None,
+               v_scale: Optional[jnp.ndarray] = None,
+               tp_axis: Optional[str] = None):
         """One decode step for a fixed-width batch.
 
         ``tokens``/``positions`` ``[b]``: each row's newest token and
@@ -188,10 +268,17 @@ class PagedDecoder:
         Idle rows carry position 0 / kv_len 1 / an all-scratch page
         row; their writes land in scratch page 0 and their outputs are
         discarded by the engine.  Returns (logits ``[b, vocab]``,
-        k_pool', v_pool')."""
+        k_pool', v_pool') — or, with ``k_scale``/``v_scale`` (the
+        quantized pool's [L, n_pages, ps, H] fp32 scale planes), a
+        5-tuple appending the updated scale planes: the append
+        quantizes on write and ``flash_decode`` dequantizes on read.
+        ``tp_axis``: per-shard body under ``shard_map`` (local head
+        slice of pool and scales, one ``psum`` per block)."""
         cfg = self.cfg
-        hd, nh = cfg.head_dim, cfg.num_heads
+        hd = cfg.head_dim
         page_size = k_pool.shape[2]
+        quantized = k_scale is not None
+        qmax = quant_qmax(k_pool.dtype) if quantized else None
         x = params["embed"][tokens] + params["pos"][positions]  # [b, h]
         page_slot = positions // page_size
         page_idx = jnp.take_along_axis(
@@ -202,17 +289,32 @@ class PagedDecoder:
             qkv = hdn @ layer["wqkv"]
             q, k, v = jnp.split(qkv, 3, axis=-1)
             b = q.shape[0]
-            k_pool = k_pool.at[li, page_idx, offset].set(
-                k.reshape(b, nh, hd))
-            v_pool = v_pool.at[li, page_idx, offset].set(
-                v.reshape(b, nh, hd))
+            nh = k.shape[-1] // hd  # LOCAL heads (H/tp under shard_map)
+            k_new, v_new = k.reshape(b, nh, hd), v.reshape(b, nh, hd)
+            if quantized:
+                k_new, k_s = quantize_tokens(k_new, k_pool.dtype, qmax)
+                v_new, v_s = quantize_tokens(v_new, v_pool.dtype, qmax)
+                k_scale = k_scale.at[li, page_idx, offset].set(k_s)
+                v_scale = v_scale.at[li, page_idx, offset].set(v_s)
+            k_pool = k_pool.at[li, page_idx, offset].set(k_new)
+            v_pool = v_pool.at[li, page_idx, offset].set(v_new)
             q4 = q.reshape(b, 1, nh, hd).transpose(0, 2, 1, 3)
-            ctx = flash_decode(q4, k_pool[li], v_pool[li],
-                               page_table, kv_len)
+            ctx = flash_decode(
+                q4, k_pool[li], v_pool[li], page_table, kv_len,
+                k_scale=k_scale[li] if quantized else None,
+                v_scale=v_scale[li] if quantized else None)
             ctx = ctx.transpose(0, 2, 1, 3).reshape(b, -1)
-            x = x + ctx @ layer["wo"]
-            x = x + _mlp(_ln(x, layer["ln2"]), layer)
+            attn = ctx @ layer["wo"]
+            if tp_axis is not None:
+                attn = jax.lax.psum(attn, tp_axis)
+            x = x + attn
+            mlp = _mlp(_ln(x, layer["ln2"]), layer)
+            if tp_axis is not None:
+                mlp = jax.lax.psum(mlp, tp_axis)
+            x = x + mlp
         logits = _ln(x, params["ln_f"]) @ params["embed"].T
+        if quantized:
+            return logits, k_pool, v_pool, k_scale, v_scale
         return logits, k_pool, v_pool
 
     # -- draft–verify / chunked prefill: multi-token extension -----------
@@ -220,7 +322,10 @@ class PagedDecoder:
     def extend(self, params, k_pool, v_pool, tokens: jnp.ndarray,
                positions: jnp.ndarray, write_pages: jnp.ndarray,
                write_offsets: jnp.ndarray, page_table: jnp.ndarray,
-               kv_len: jnp.ndarray, *, last_only: bool = False):
+               kv_len: jnp.ndarray, *, last_only: bool = False,
+               k_scale: Optional[jnp.ndarray] = None,
+               v_scale: Optional[jnp.ndarray] = None,
+               tp_axis: Optional[str] = None):
         """Append ``q`` tokens per row to the paged cache and score
         them in one :func:`~apex_tpu.ops.flash_decode` launch.
 
@@ -242,29 +347,52 @@ class PagedDecoder:
         ``last_only`` (static): project only the final row through the
         LM head — the chunked-prefill shape, where one next-token
         distribution is wanted and front-padding pins the chunk's last
-        valid token to row ``q - 1``.  Returns (logits
-        ``[b, q, vocab]`` or ``[b, 1, vocab]``, k_pool', v_pool').
+        valid token to row ``q - 1``.  ``k_scale``/``v_scale`` and
+        ``tp_axis``: as in :meth:`decode` (quantize-on-write appends /
+        per-shard ``shard_map`` body).  Returns (logits
+        ``[b, q, vocab]`` or ``[b, 1, vocab]``, k_pool', v_pool'[,
+        k_scale', v_scale']).
         """
         cfg = self.cfg
-        hd, nh = cfg.head_dim, cfg.num_heads
+        hd = cfg.head_dim
         b, q = tokens.shape
+        quantized = k_scale is not None
+        qmax = quant_qmax(k_pool.dtype) if quantized else None
         x = params["embed"][tokens] + params["pos"][positions]  # [b, q, h]
         for li, layer in enumerate(params["layers"]):
             hdn = _ln(x, layer["ln1"])
             qkv = hdn @ layer["wqkv"]
             qh, kh, vh = jnp.split(qkv, 3, axis=-1)
-            k_pool = k_pool.at[li, write_pages, write_offsets].set(
-                kh.reshape(b, q, nh, hd))
-            v_pool = v_pool.at[li, write_pages, write_offsets].set(
-                vh.reshape(b, q, nh, hd))
+            nh = kh.shape[-1] // hd  # LOCAL heads (H/tp under shard_map)
+            k_new = kh.reshape(b, q, nh, hd)
+            v_new = vh.reshape(b, q, nh, hd)
+            if quantized:
+                k_new, k_s = quantize_tokens(k_new, k_pool.dtype, qmax)
+                v_new, v_s = quantize_tokens(v_new, v_pool.dtype, qmax)
+                k_scale = k_scale.at[li, write_pages,
+                                     write_offsets].set(k_s)
+                v_scale = v_scale.at[li, write_pages,
+                                     write_offsets].set(v_s)
+            k_pool = k_pool.at[li, write_pages, write_offsets].set(k_new)
+            v_pool = v_pool.at[li, write_pages, write_offsets].set(v_new)
             q4 = qh.reshape(b, q, nh, hd).transpose(0, 2, 1, 3)
-            ctx = flash_decode(q4, k_pool[li], v_pool[li],
-                               page_table, kv_len)
+            ctx = flash_decode(
+                q4, k_pool[li], v_pool[li], page_table, kv_len,
+                k_scale=k_scale[li] if quantized else None,
+                v_scale=v_scale[li] if quantized else None)
             ctx = ctx.transpose(0, 2, 1, 3).reshape(b, q, -1)
-            x = x + ctx @ layer["wo"]
-            x = x + _mlp(_ln(x, layer["ln2"]), layer)
+            attn = ctx @ layer["wo"]
+            if tp_axis is not None:
+                attn = jax.lax.psum(attn, tp_axis)
+            x = x + attn
+            mlp = _mlp(_ln(x, layer["ln2"]), layer)
+            if tp_axis is not None:
+                mlp = jax.lax.psum(mlp, tp_axis)
+            x = x + mlp
         x = _ln(x, params["ln_f"])
         if last_only:
             x = x[:, -1:, :]
         logits = x @ params["embed"].T
+        if quantized:
+            return logits, k_pool, v_pool, k_scale, v_scale
         return logits, k_pool, v_pool

@@ -70,7 +70,11 @@ import dataclasses
 from collections import deque
 from typing import Deque, Dict, List, Optional
 
-from apex_tpu.serving.kv_cache import PagedKVCache, PagePoolExhausted
+from apex_tpu.serving.kv_cache import (
+    PagedKVCache,
+    PagePoolExhausted,
+    PrefixIndex,
+)
 
 WAITING = "waiting"
 RUNNING = "running"
@@ -108,6 +112,11 @@ class Request:
     # re-prefill, so preemption/restore reset it to start over (the
     # same contract that keeps KV pages out of engine snapshots).
     prefill_pos: Optional[int] = None
+    # r17 prefix sharing: True when the CURRENT admission covered a
+    # context prefix with shared pages (reset on preemption — a
+    # re-admission does its own lookup).  Telemetry-visible as the
+    # request_admit event's ``prefix_hit`` bool.
+    prefix_hit: bool = False
     preemptions: int = 0
     admit_t: Optional[float] = None
     first_token_t: Optional[float] = None
@@ -166,11 +175,21 @@ class ContinuousBatchingScheduler:
                  prefill_budget: int, max_position: int,
                  max_queue: Optional[int] = None,
                  preempt_cap: Optional[int] = 4,
-                 chunk_size: Optional[int] = None):
+                 chunk_size: Optional[int] = None,
+                 prefix_index: Optional[PrefixIndex] = None):
         if chunk_size is not None and chunk_size > prefill_budget:
             raise ValueError(
                 f"chunk_size {chunk_size} exceeds the per-step prefill "
                 f"budget {prefill_budget} — a chunk could never launch")
+        if prefix_index is not None and chunk_size is None:
+            # a prefix hit admits the request mid-context — its suffix
+            # prefills through the fixed [1, chunk_size] extend
+            # executable, attending over the shared pages.  Without a
+            # chunk path there is no way to compute a suffix's K/V
+            # against an existing cache.
+            raise ValueError(
+                "prefix sharing requires chunked prefill "
+                "(chunk_size=None)")
         self.cache = cache
         self.max_batch = max_batch
         self.prefill_budget = prefill_budget
@@ -184,6 +203,12 @@ class ContinuousBatchingScheduler:
         # boundary under the shared prefill-token budget — instead of
         # one whole-row launch (None = every prefill is whole-row)
         self.chunk_size = chunk_size
+        # prefix sharing (r17): admission consults the index for a
+        # shared prefix (pages refcounted, prefill skipped for the
+        # covered tokens); allocation pressure evicts index entries
+        # BEFORE preempting a running request — dropping warm-cache
+        # opportunism is always cheaper than killing live work
+        self.prefix_index = prefix_index
         self.waiting: Deque[Request] = deque()
         self.running: List[Request] = []   # admission order
         self.finished: List[Request] = []
@@ -296,14 +321,32 @@ class ContinuousBatchingScheduler:
                 len(self.running) + len(admitted) < self.max_batch:
             req = self.waiting[0]
             ctx = req.seq_len
-            chunked = self.chunk_size is not None and ctx > self.chunk_size
-            need = self.chunk_size if chunked else ctx
+            # prefix sharing: the longest indexed prefix of the context
+            # rides in on shared pages; only the suffix [m, ctx) is
+            # prefilled, always through the chunk path (it must attend
+            # over the shared pages)
+            m, shared = (0, [])
+            if self.prefix_index is not None:
+                m, shared = self.prefix_index.lookup(req.context)
+            if m:
+                chunked = True
+                need = min(self.chunk_size, ctx - m)
+            else:
+                chunked = (self.chunk_size is not None
+                           and ctx > self.chunk_size)
+                need = self.chunk_size if chunked else ctx
             if need > budget:
                 break
+            if shared:
+                # pin the shared pages FIRST: index eviction inside
+                # the allocation retry below may otherwise free them
+                self.cache.share(shared)
             try:
-                pages = self.cache.allocate(
-                    self.cache.pages_needed(ctx), req.rid)
+                fresh = self._allocate_evicting(
+                    self.cache.pages_needed(ctx) - len(shared), req.rid)
             except PagePoolExhausted:
+                if shared:
+                    self.cache.free(shared)
                 if not self.running and not admitted:
                     # nothing to preempt and nothing in flight: the
                     # waiting request's context alone exceeds the pool
@@ -311,16 +354,66 @@ class ContinuousBatchingScheduler:
                     # is a sizing bug, not a transient
                     raise
                 break
+            pages = list(shared) + fresh
+            if m % self.cache.page_size:
+                # the hit ends MID-page: the suffix's first chunk will
+                # write position m into the last shared page, so it is
+                # copy-on-write'd HERE, at admission, where exhaustion
+                # is still an ordinary stop-admitting event — a COW
+                # failing mid-launch would have no clean rollback
+                try:
+                    self._privatize(pages, m // self.cache.page_size,
+                                    req.rid)
+                except PagePoolExhausted:
+                    self.cache.free(pages)
+                    if not self.running and not admitted:
+                        raise
+                    break
             self.waiting.popleft()
             req.pages = pages
             req.state = RUNNING
+            req.prefix_hit = bool(m)
             budget -= need
             if chunked:
-                req.prefill_pos = 0
-                chunks.append((req, 0, min(self.chunk_size, ctx)))
+                req.prefill_pos = m
+                chunks.append((req, m, min(self.chunk_size, ctx - m)))
             admitted.append(req)
         self.running.extend(admitted)
         return chunks, admitted
+
+    def _allocate_evicting(self, n: int, rid: int) -> List[int]:
+        """:meth:`PagedKVCache.allocate`, but allocation pressure
+        first evicts prefix-index entries (oldest-first) — an index
+        entry is a reuse OPPORTUNITY, never a reason to fail an
+        admission or preempt live work.  Only entries whose pages drop
+        to refcount zero actually return capacity; entries still read
+        by live requests release nothing (their pages stay live), so
+        the loop is bounded by the index size."""
+        while True:
+            try:
+                return self.cache.allocate(n, rid)
+            except PagePoolExhausted:
+                if self.prefix_index is None or \
+                        len(self.prefix_index) == 0:
+                    raise
+                self.prefix_index.evict_one()
+
+    def _privatize(self, pages: List[int], idx: int, rid: int) -> None:
+        """Copy-on-write ``pages[idx]`` in place for ``rid``, evicting
+        prefix-index entries under allocation pressure (the same relief
+        order as :meth:`_allocate_evicting`).  If an eviction drops the
+        page's OTHER reader, the caller's pin is the only reference
+        left and no copy is needed — the loop re-checks sharedness
+        before each attempt."""
+        while self.cache.is_shared(pages[idx]):
+            try:
+                pages[idx] = self.cache.cow(pages[idx], rid)
+                return
+            except PagePoolExhausted:
+                if self.prefix_index is None or \
+                        len(self.prefix_index) == 0:
+                    raise
+                self.prefix_index.evict_one()
 
     # -- growth / preemption ---------------------------------------------
 
@@ -352,6 +445,8 @@ class ContinuousBatchingScheduler:
         # a mid-chunk victim restarts its chunked prefill on
         # re-admission — chunk progress is rebuildable, like KV
         victim.prefill_pos = None
+        # re-admission does its own prefix lookup
+        victim.prefix_hit = False
         victim.state = WAITING
         victim.preemptions += 1
         self.waiting.appendleft(victim)
@@ -386,6 +481,13 @@ class ContinuousBatchingScheduler:
                         self.cache.allocate(
                             need_pages - len(req.pages), req.rid))
                 except PagePoolExhausted:
+                    # pressure relief order: drop a prefix-index entry
+                    # first (reuse opportunism is cheaper than killing
+                    # live work), preempt only once the index is dry
+                    if self.prefix_index is not None and \
+                            len(self.prefix_index):
+                        self.prefix_index.evict_one()
+                        continue
                     # the victim can be ``req`` itself (it is the
                     # newest admission left): then the loop's membership
                     # check ends its growth and it waits its turn

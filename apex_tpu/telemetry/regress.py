@@ -55,6 +55,12 @@ GATED_LOWER = (
     # direction is pinned by test_fleet_key_direction_rules), it adds
     # no new coverage.
     r"fleet_ttft_\w*_ms$",
+    # r17: pool occupancy high-water mark (serving_pool_peak, a
+    # FRACTION of the page pool, not a byte count — the quantized-KV
+    # headline: the committed r17 pair gates pool peak DOWN ≥ 40% on
+    # the int8 pool).  Genuinely new coverage: no suffix rule above
+    # matches it.  Direction pinned by test_pool_peak_direction_rule.
+    r"_pool_peak$",
 )
 
 #: Higher-is-better key patterns: throughput, efficiency, rooflines,
@@ -68,6 +74,13 @@ GATED_HIGHER = (
     # ISSUE 16: fleet aggregate throughput (documented-redundant with
     # _per_sec$, same contract as the fleet_ttft entry above)
     r"fleet_\w*_tokens_per_sec$",
+    # r17: prefix-sharing hit rate (serving_prefix_hit_rate).
+    # Deliberately redundant with _hit_rate$ above, same contract as
+    # the fleet entries: this entry DOCUMENTS that the committed r17
+    # pair gates the key UP (non-zero on the shared-prompt trace; the
+    # direction is pinned by test_prefix_hit_rate_direction_rule), it
+    # adds no new coverage.
+    r"_prefix_hit_rate$",
 )
 
 

@@ -194,6 +194,13 @@ EVENT_FIELDS: Dict[str, Dict[str, FieldSpec]] = {
         # a REAL bool, present only when the request admitted into
         # chunked prefill (ISSUE 12) — absent means whole-row
         "chunked": opt(bool),
+        # a REAL bool (r17): present on EVERY admit while prefix
+        # sharing is on — True when the page-aligned prompt prefix
+        # matched the PrefixIndex (shared pages pinned, prefill
+        # resumed past the match), False on a miss.  Emitting misses
+        # too is what gives summarize its hit-rate denominator;
+        # absent entirely means sharing was off
+        "prefix_hit": opt(bool),
     },
     "request_retire": {
         "rid": req(int),
@@ -221,6 +228,11 @@ EVENT_FIELDS: Dict[str, Dict[str, FieldSpec]] = {
         "spec_verify": opt(bool),
         "spec_drafted": opt(int),
         "spec_accepted": opt(int),
+        # prefix sharing (r17): pages currently referenced by more
+        # than one holder (an int COUNT, never a bool — pairs with
+        # pool_used for the memory-saved story); present only while
+        # prefix sharing is on
+        "pool_shared_pages": opt(int),
     },
     # serving resilience (ISSUE 10): overload rejects, deadline deaths
     # (where = "queued" shed / "running" timeout), crash recovery.

@@ -40,6 +40,8 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     pool_occ: List[float] = []
     commit_tokens = commit_rows = 0
     spec_drafted = spec_accepted = 0
+    prefix_hits = prefix_total = 0
+    shared_pages_peak = None
     deadline_hits = deadline_total = 0
     queue_sheds = run_timeouts = 0
     phase_ms: Dict[str, List[float]] = {}
@@ -66,6 +68,13 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             if ev.get("deadline_hit") is not None:
                 deadline_total += 1
                 deadline_hits += 1 if ev["deadline_hit"] else 0
+        elif ev.get("type") == "request_admit":
+            # prefix sharing (r17): the field is present on every admit
+            # while sharing is on — misses included, which is what makes
+            # hits/total a real hit RATE rather than a hit count
+            if ev.get("prefix_hit") is not None:
+                prefix_total += 1
+                prefix_hits += 1 if ev["prefix_hit"] else 0
         elif ev.get("type") == "request_timeout":
             # a timed-out request HAD a deadline by definition and
             # missed it — it counts in the hit-rate denominator even
@@ -86,6 +95,10 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             commit_rows += int(ev.get("batch", 0))
             spec_drafted += int(ev.get("spec_drafted", 0))
             spec_accepted += int(ev.get("spec_accepted", 0))
+            if ev.get("pool_shared_pages") is not None:
+                sp = int(ev["pool_shared_pages"])
+                shared_pages_peak = (sp if shared_pages_peak is None
+                                     else max(shared_pages_peak, sp))
         elif ev.get("type") == "profile":
             for k, v in (ev.get("phase_ms") or {}).items():
                 phase_ms.setdefault(k, []).append(float(v))
@@ -155,6 +168,12 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         out["serving_deadline_hit_rate"] = (
             round(deadline_hits / deadline_total, 4)
             if deadline_total else None)
+        # prefix sharing (r17): hit rate over every sharing-on admit,
+        # and the pool's peak count of pages held by >1 reader
+        out["serving_prefix_hit_rate"] = (
+            round(prefix_hits / prefix_total, 4)
+            if prefix_total else None)
+        out["serving_shared_pages_peak"] = shared_pages_peak
     if counts.get("profile"):
         # phase attribution (ISSUE 9): mean per-phase device ms over the
         # run's sampled windows — the answer to "where do a step's
@@ -242,6 +261,12 @@ def format_summary(s: Dict[str, Any]) -> str:
         if s.get("serving_deadline_hit_rate") is not None:
             parts.append(
                 f"deadline hit {_pct(s['serving_deadline_hit_rate'])}")
+        if s.get("serving_prefix_hit_rate") is not None:
+            parts.append(
+                f"prefix hit {_pct(s['serving_prefix_hit_rate'])}")
+        if s.get("serving_shared_pages_peak"):
+            parts.append(
+                f"shared pages peak {s['serving_shared_pages_peak']}")
         lines.append("  ".join(parts))
     if s.get("profile_samples"):
         parts = ["phases      " + "  ".join(
@@ -286,6 +311,10 @@ _DIFF_ROWS = (
     ("serving_accepted_tokens_per_step", "acc tok/step", "{:.3f}"),
     # overload health (ISSUE 10): did the change move the SLO story?
     ("serving_deadline_hit_rate", "deadline hit", "{:.3f}"),
+    # memory-lean serving (r17): did prefix sharing land, and did the
+    # quantized pool move the occupancy high-water mark?
+    ("serving_prefix_hit_rate", "prefix hit", "{:.3f}"),
+    ("serving_pool_peak", "pool peak", "{:.3f}"),
     # phase-attribution rows (ISSUE 9): did the change move exposed
     # communication or the memory high-water mark?
     ("exposed_collective_ms", "exposed (ms)", "{:.2f}"),

@@ -24,6 +24,7 @@ state.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Optional, Sequence, Tuple
 
@@ -255,7 +256,61 @@ def _compute_rank_info(mesh: Mesh) -> Tuple[int, int, int]:
     return (0, 0, pid)
 
 
+def tensor_parallel_mesh(tp: Optional[int] = None) -> Mesh:
+    """One-axis ``Mesh`` over :data:`TENSOR_AXIS` — the tp-sharded
+    serving engine's mesh (its ``shard_map`` bodies ``psum`` over this
+    axis; r17, docs/serving.md "Tensor-parallel serving").
+
+    With the global model-parallel state initialized, the serving mesh
+    is the FIRST tensor group of the registered 3-D mesh: same devices,
+    same axis name, so the serving engine and the training stack agree
+    on what "tensor" means and the HLO contract vocabulary is shared.
+    ``tp``, when given, must then match the registered tensor world
+    size.  Uninitialized, ``tp`` is required and the mesh takes the
+    first ``tp`` local devices.
+    """
+    if model_parallel_is_initialized():
+        st = _state()
+        world = st.tensor_model_parallel_size
+        if tp is not None and tp != world:
+            raise ValueError(
+                f"tp={tp} does not match the initialized tensor-"
+                f"parallel world size {world}")
+        devs = np.asarray(st.mesh.devices).reshape(-1, world)[0]
+        return Mesh(devs, (TENSOR_AXIS,))
+    if tp is None:
+        raise ValueError(
+            "tp is required when model-parallel state is uninitialized")
+    devs = jax.devices()
+    if len(devs) < tp:
+        raise ValueError(f"tp={tp} needs {tp} devices, have {len(devs)}")
+    return Mesh(np.asarray(devs[:tp]), (TENSOR_AXIS,))
+
+
 def destroy_model_parallel() -> None:
     """Reference :373-396."""
     global _STATE
     _STATE = None
+
+
+@contextlib.contextmanager
+def uninitialized_scope():
+    """Temporarily hide the global model-parallel state.
+
+    Inside the ``with`` block :func:`model_parallel_is_initialized` is
+    False and :func:`tensor_parallel_mesh` builds from the first local
+    devices; on exit the previous state (if any) is restored untouched.
+
+    This exists for consumers that must construct a FIXED canonical
+    geometry regardless of what a surrounding training process has
+    registered — chiefly ``apex_tpu.analysis.registry``, whose HLO
+    contracts pin the cpu-toy serving mesh and must lower identically
+    whether invoked from a fresh CLI process or mid-suite after a test
+    initialized an unrelated mesh.
+    """
+    global _STATE
+    saved, _STATE = _STATE, None
+    try:
+        yield
+    finally:
+        _STATE = saved

@@ -1774,6 +1774,33 @@ def bench_serving():
     either way, so ``telemetry regress`` gates the spec-on/spec-off
     pair directly (acceptance up, TTFT/TPOT no worse).  The committed
     ``BENCH_r12{,b}_serving.json`` pair is exactly that A/B.
+
+    r17 serving-perf knobs (docs/serving.md):
+
+    * ``BENCH_SERVING_TP`` — tensor-parallel decode width (needs that
+      many jax devices; the cpu-toy records run under the emulated
+      8-device mesh, same recipe as tests/conftest.py);
+    * ``BENCH_SERVING_KV_QUANT`` — ``int8``/``fp8`` pool codes.  The
+      pool is **byte-matched**: the same HBM budget buys more pages at
+      the quantized bytes-per-token, so ``serving_pool_peak`` (an
+      occupancy FRACTION) drops when quantization actually pays;
+    * ``BENCH_SERVING_PREFIX`` — prefix sharing on a SHARED-PROMPT
+      trace: every request gets the same ``BENCH_SERVING_PREFIX_LEN``-
+      token system prompt, so ``serving_prefix_hit_rate`` (hits over
+      ALL sharing-on admissions) measures how much prefill the
+      PrefixIndex elided.  Implies chunked prefill;
+    * ``BENCH_SERVING_TIMEBASE=virtual-flops`` — the decode-throughput
+      denominator becomes analytic per-token matmul work on THIS
+      side's shard (layer flops / tp + the unsharded logits matmul)
+      at a fixed virtual rate, instead of host wall.  Emulated CPU
+      "devices" share one socket, so wall time CANNOT show a tp
+      speedup that is real on hardware; the virtual timebase shows the
+      work-partitioning effect honestly and is stamped in
+      ``serving_config.timebase`` so nobody reads it as wall.  The
+      committed ``BENCH_r17{,b}_serving.json`` pair (A = tp1/bf16-KV/
+      sharing-off, B = tp2/int8-KV/sharing-on, both virtual-flops
+      cpu-toy) is the r17 A/B: throughput up, pool peak down >= 40%,
+      prefix hit rate off zero.
     """
     from apex_tpu import telemetry as tel
     from apex_tpu.telemetry.summarize import percentile
@@ -1796,8 +1823,16 @@ def bench_serving():
     # the knobs compose at tiny toy geometries too
     chunk = int(os.environ.get("BENCH_SERVING_CHUNK",
                                str(min(max_pos, max(64, max_pos // 8)))))
+    tp = int(os.environ.get("BENCH_SERVING_TP", "1"))
+    kv_quant = os.environ.get("BENCH_SERVING_KV_QUANT") or None
+    prefix_on = os.environ.get("BENCH_SERVING_PREFIX", "0") == "1"
+    timebase = os.environ.get("BENCH_SERVING_TIMEBASE", "wall")
     spec = (SpecConfig(k=spec_k, proposer=NgramProposer(),
                        chunk_size=chunk) if spec_on else None)
+    if prefix_on and spec is None:
+        # prefix sharing needs chunked prefill (the resume-past-the-
+        # match path); k=0 keeps the draft-verify machinery off
+        spec = SpecConfig(k=0, chunk_size=chunk)
     cfg = ServingModelConfig(
         vocab_size=V, hidden_size=H, num_heads=NH, num_layers=L,
         max_position=max_pos, dtype=jnp.bfloat16)
@@ -1807,10 +1842,32 @@ def bench_serving():
     # max_pos=1024: prompts 64..256, generation budgets 16..64)
     prompt_len = (max(4, max_pos // 16), max(8, max_pos // 4))
     max_new = (max(2, max_pos // 64), max(4, max_pos // 16))
-    pages_per_req = -(-(prompt_len[1] + max_new[1]) // page_size)
+    # shared-prompt trace (r17): the same system prompt heads every
+    # request, two pages by default so the shareable prefix is page-
+    # aligned at any page size
+    prefix_len = (int(os.environ.get("BENCH_SERVING_PREFIX_LEN",
+                                     str(2 * page_size)))
+                  if prefix_on else 0)
+    system_prompt = [1 + (7 * i) % (V - 1) for i in range(prefix_len)]
+
+    def share_prompt(reqs):
+        for r in reqs:
+            r.prompt = system_prompt + r.prompt
+        return reqs
+
+    pages_per_req = -(-(prefix_len + prompt_len[1] + max_new[1])
+                      // page_size)
     # 1.5x the worst simultaneous footprint: headroom for steady state,
     # small enough that a bursty trace still exercises pool pressure
     num_pages = 1 + max_batch * pages_per_req * 3 // 2
+    if kv_quant is not None:
+        # byte-matched pool: the SAME HBM budget buys more pages at the
+        # quantized bytes per (token, head) — int8/fp8 code bytes + one
+        # f32 scale vs the bf16 plane.  serving_pool_peak is occupancy
+        # over THIS page count, so the key moves only if quantization
+        # really buys capacity.
+        hd = H // NH
+        num_pages = num_pages * (2 * hd) // (hd + 4)
 
     tel_dir = os.environ.get("BENCH_TELEMETRY_DIR") or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "telemetry")
@@ -1826,14 +1883,16 @@ def bench_serving():
                         page_size=page_size, max_batch=max_batch,
                         max_pages_per_request=pages_per_req,
                         prefill_budget=max_pos, telemetry=bus,
-                        spec=spec)
+                        spec=spec, tp=tp, kv_quant=kv_quant,
+                        prefix_sharing=prefix_on)
 
     # warm both compiled shapes OUTSIDE the measured trace (and outside
     # the stream: TTFT must not carry jit compile time)
     compile_s = eng.warmup()
 
-    trace = poisson_trace(0, n_req, rate=rate, prompt_len=prompt_len,
-                          max_new=max_new, vocab_size=V)
+    trace = share_prompt(
+        poisson_trace(0, n_req, rate=rate, prompt_len=prompt_len,
+                      max_new=max_new, vocab_size=V))
     t0 = time.perf_counter()
     # snapshot: serve() returns the scheduler's CUMULATIVE finished
     # list, and the attribution mini-trace below appends to it — the
@@ -1854,9 +1913,10 @@ def bench_serving():
         samp = ProfileSampler(bus, window=1)
         # rid_base keeps the stream's rids unique across the run's
         # three traces (measured / mini / overload)
-        mini = poisson_trace(1, max(2, max_batch // 2), rate=rate,
-                             prompt_len=prompt_len, max_new=max_new,
-                             vocab_size=V, rid_base=50_000)
+        mini = share_prompt(
+            poisson_trace(1, max(2, max_batch // 2), rate=rate,
+                          prompt_len=prompt_len, max_new=max_new,
+                          vocab_size=V, rid_base=50_000))
         rep = samp.capture(lambda: eng.serve(mini), step=None)
         if rep is None:
             profile_keys["serving_profile_error"] = (
@@ -1900,9 +1960,10 @@ def bench_serving():
     eng.sched.max_queue = 2 * max_batch  # host-side policy knob only:
     # no device shape changes, so the two compiled executables serve
     # the overload segment as-is
-    over_trace = poisson_trace(2, n_over, rate=2.0 * rate,
-                               prompt_len=prompt_len, max_new=max_new,
-                               vocab_size=V, rid_base=100_000)
+    over_trace = share_prompt(
+        poisson_trace(2, n_over, rate=2.0 * rate,
+                      prompt_len=prompt_len, max_new=max_new,
+                      vocab_size=V, rid_base=100_000))
     # per-request SLO derived from the measured segment's latencies:
     # first token within ~2x the observed TTFT median, then each new
     # token at ~3x the observed TPOT median — tight enough that 2x
@@ -1956,6 +2017,18 @@ def bench_serving():
                         if ev.get("type") == "decode_step")
     decode_s = sum(ev.get("step_ms", 0.0) for ev in measured
                    if ev.get("type") == "decode_step") / 1e3
+    if timebase == "virtual-flops":
+        # analytic decode timebase (r17): per-token matmul work on THIS
+        # side's shard — the tp-sharded layer matmuls (wqkv, wo, w1,
+        # w2) divide by tp, the logits matmul against the replicated
+        # embedding does not — at a fixed 1 TFLOP/s virtual rate.
+        # Attention score/value reads are kv-length-dependent and
+        # params-dominated at these geometries; deliberately excluded
+        # (both sides of a pair exclude them identically).
+        ffn = cfg.mlp_ratio * H
+        flops_tok = (2.0 * L * (H * 3 * H + H * H + 2 * H * ffn) / tp
+                     + 2.0 * H * V)
+        decode_s = decode_tokens * flops_tok / 1e12
     total_tokens = sum(len(r.generated) for r in finished)
     return {
         "serving_requests": len(finished),
@@ -1972,6 +2045,12 @@ def bench_serving():
         "serving_accepted_tokens_per_step":
             s.get("serving_accepted_tokens_per_step"),
         "serving_spec_accept_rate": s.get("serving_spec_accept_rate"),
+        # r17 headlines, numeric on EVERY record (0.0 with sharing off,
+        # never null) so a committed A/B pair can gate them via --keys
+        "serving_prefix_hit_rate": s.get("serving_prefix_hit_rate")
+        or 0.0,
+        "serving_shared_pages_peak": s.get("serving_shared_pages_peak")
+        or 0,
         "serving_decode_steps": eng.decode_steps,
         "serving_preemptions": sum(r.preemptions for r in finished),
         "serving_wall_s": round(wall_s, 2),
@@ -1992,6 +2071,15 @@ def bench_serving():
                          else jax.default_backend()),
             "speculation": ({"k": spec_k, "chunk_size": chunk,
                              "proposer": "ngram"} if spec_on else None),
+            # r17 mode + timebase stamps: "virtual-flops" means the
+            # decode_tokens_per_sec denominator is analytic shard
+            # work, NOT wall — a reader comparing against a wall
+            # record must be able to tell
+            "tp": tp,
+            "kv_quant": kv_quant,
+            "prefix_sharing": ({"prefix_len": prefix_len}
+                               if prefix_on else None),
+            "timebase": timebase,
         },
     }
 

@@ -214,6 +214,34 @@ def test_hlo_contract_gate_zero_violations():
     assert res.exit_code == 0
 
 
+def test_serving_tp_builders_ignore_ambient_parallel_state():
+    """r17 regression pin: the serving_tp_* builders lower the pinned
+    tp=2 cpu-toy geometry even when a surrounding process has the
+    global model-parallel state registered with a DIFFERENT tensor
+    world (the exact leak a module-scoped training fixture can leave
+    behind mid-suite).  Without ``uninitialized_scope`` this raises
+    ``tp=2 does not match the initialized tensor-parallel world size
+    1`` and the gate above reports builder errors."""
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(
+        1, 1, devices=jax.devices()[:1])
+    R._toy_engine_tp.cache_clear()
+    R._serving_tp_lowered.cache_clear()
+    try:
+        lowered = R._serving_tp_lowered()
+        # the sweep lowers all five executables; the registry registers
+        # the hot-path subset
+        assert set(R.SERVING_TP_EXECUTABLES) <= set(lowered)
+        # and the ambient state survived the build untouched
+        assert parallel_state.get_tensor_model_parallel_world_size() == 1
+    finally:
+        parallel_state.destroy_model_parallel()
+        R._toy_engine_tp.cache_clear()
+        R._serving_tp_lowered.cache_clear()
+
+
 def test_committed_contracts_pin_the_properties_that_matter():
     """The committed entries encode the real invariants: serving is
     communication-lean and host-silent with the pool donation
@@ -626,5 +654,12 @@ def test_serving_docstring_matches_docs_table_and_registry():
 
     serving_entries = [x for x in R.registered_executables()
                       if x.startswith("serving_")]
-    assert serving_entries == [f"serving_{x}"
-                               for x in E.SERVING_EXECUTABLES]
+    base = [x for x in serving_entries if not x.startswith("serving_tp_")]
+    assert base == [f"serving_{x}" for x in E.SERVING_EXECUTABLES]
+    # r17: the tp-sharded serving modes register their own family —
+    # every entry names an executable from the SAME compiled set (the
+    # tp engine changes sharding and pool dtype, not the shape table)
+    tp = [x for x in serving_entries if x.startswith("serving_tp_")]
+    from apex_tpu.analysis.registry import SERVING_TP_EXECUTABLES
+    assert tp == [f"serving_tp_{x}" for x in SERVING_TP_EXECUTABLES]
+    assert set(SERVING_TP_EXECUTABLES) <= set(E.SERVING_EXECUTABLES)

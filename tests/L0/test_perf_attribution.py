@@ -621,6 +621,78 @@ def test_fleet_key_direction_rules():
     assert key_direction("fleet_restart_wall_s") is None
 
 
+def test_pool_peak_direction_rule():
+    """r17: the pool-occupancy high-water mark is gated lower-is-better
+    by the explicit *_pool_peak$ rule (no generic suffix covers a
+    fraction) — the quantized-KV headline's direction, pinned by name
+    from the regress.py comment."""
+    from apex_tpu.telemetry.regress import key_direction
+
+    assert key_direction("serving_pool_peak") == "lower"
+    assert key_direction("fleet_pool_peak") == "lower"
+    # neighbors in the same family stay ungated: a shared-page count or
+    # a pool size has no universally better direction
+    assert key_direction("serving_shared_pages_peak") is None
+    assert key_direction("serving_pool_pages") is None
+
+
+def test_prefix_hit_rate_direction_rule():
+    """r17: prefix-sharing hit rate is gated higher-is-better — by the
+    explicit family rule (documented-redundant with _hit_rate$), while
+    shed rate stays deliberately direction-free."""
+    from apex_tpu.telemetry.regress import key_direction
+
+    assert key_direction("serving_prefix_hit_rate") == "higher"
+    assert key_direction("serving_deadline_hit_rate") == "higher"
+    assert key_direction("serving_shed_rate") is None
+
+
+def test_regress_serving_keys_mandatory_on_committed_r17_pair(capsys):
+    """r17 satellite: the serving-mode headline keys are MANDATORY over
+    the committed r17 pair (A = tp=1 full-precision unshared, B = tp=2
+    + int8 pool + prefix sharing; same offered load, virtual-flops
+    timebase, both cpu-toy self-stamped).  The gate proves the
+    acceptance criteria on committed data: decode throughput scales
+    with tp, the byte-matched int8 pool cuts the occupancy peak by at
+    least the claimed 40%, and the shared-prompt trace actually hits
+    the prefix index."""
+    a = os.path.join(REPO, "BENCH_r17_serving.json")
+    b = os.path.join(REPO, "BENCH_r17b_serving.json")
+    rc = tele_cli(["regress", a, b, "--max-regress", "25", "--json",
+                   "--keys", "decode_tokens_per_sec,"
+                             "serving_pool_peak,"
+                             "serving_prefix_hit_rate"])
+    rec = json.loads(capsys.readouterr().out)
+    assert rc == 0, rec["failures"]
+    by_key = {r["key"]: r for r in rec["rows"]}
+    tok = by_key["decode_tokens_per_sec"]
+    assert tok["direction"] == "higher" and tok["b"] > tok["a"]
+    peak = by_key["serving_pool_peak"]
+    assert peak["direction"] == "lower"
+    assert peak["b"] <= 0.6 * peak["a"]        # the >= 40% claim
+    hit = by_key["serving_prefix_hit_rate"]
+    assert hit["direction"] == "higher"
+    assert hit["a"] == 0.0 and hit["b"] > 0.0  # sharing off vs hitting
+    ka, kb = (json.load(open(p)) for p in (a, b))
+    for rec_ in (ka, kb):
+        # geometry + timebase provenance on BOTH records: emulated CPU
+        # devices share one socket, so the tp speedup is only honest
+        # under the virtual-flops timebase the records self-declare
+        assert rec_["serving_config"]["geometry"] == "cpu-toy"
+        assert rec_["serving_config"]["timebase"] == "virtual-flops"
+    assert ka["serving_config"]["tp"] == 1 and ka["serving_config"][
+        "kv_quant"] is None
+    assert kb["serving_config"]["tp"] == 2 and kb["serving_config"][
+        "kv_quant"] == "int8"
+    assert kb["serving_config"]["prefix_sharing"] is not None
+    # the B side really shared pages, not just counted hits
+    assert kb["serving_shared_pages_peak"] > 0
+    # ...and a vanished mandatory key is a failure, not a skip
+    assert tele_cli(["regress", a, b, "--max-regress", "25",
+                     "--keys", "decode_tokens_per_sec,"
+                               "gone_key"]) == 1
+
+
 def test_multichip_records_are_geometry_stamped(tmp_path):
     """ISSUE 15 satellite (the ROADMAP maintenance note's last gap):
     every committed MULTICHIP_r*.json self-declares its geometry, and

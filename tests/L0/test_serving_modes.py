@@ -1,0 +1,490 @@
+"""r17 serving execution modes: tp-sharded decode, prefix-sharing /
+copy-on-write pages, and the quantized KV pool (docs/serving.md
+"Tensor-parallel serving" / "Prefix sharing" / "Quantized KV pool").
+
+The parity ladder this file pins:
+
+- FULL-PRECISION routes (tp=1 and tp>1) are BITWISE: batched ==
+  sequential == tp=1, token for token — the head shards recombine
+  through one deterministic psum per residual, so tensor parallelism
+  must not move a single logit past the argmax.
+- The QUANTIZED route's bar is DETERMINISM, not fp equality: int8
+  batched == int8 sequential == int8 re-run, bitwise — but the int8
+  streams may legitimately diverge from the fp pool's (the bitwise
+  claim vs full precision is explicitly NOT made; docs/serving.md
+  "Parity bar").
+- Prefix sharing changes WHERE K/V bytes live, never what any reader
+  computes: shared-prefix admissions produce the exact streams of an
+  unshared control engine.
+
+Resilience rides the same ladder: kill-mid-decode recovery and the
+snapshot/restore round trip re-prove stream equality on the tp=2 +
+int8 engine (re-quantization is deterministic, so rebuild lands on
+the same codes), and the zero-compiles-after-warmup guard extends
+over every new executable, the COW page copy included.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from apex_tpu.analysis import hot_path_guard
+from apex_tpu.resilience import chaos
+from apex_tpu.serving import (PagedKVCache, PrefixIndex, ServingEngine,
+                              ServingModelConfig, SimClock, SpecConfig,
+                              init_params)
+
+pytestmark = pytest.mark.serving
+
+CFG = ServingModelConfig(vocab_size=64, hidden_size=32, num_heads=4,
+                         num_layers=2, max_position=96)
+
+#: shapes chosen to cross page boundaries at page_size=8 and to give
+#: the n-gram proposer something to accept on the spec engines
+PROMPTS = [[1, 2, 3, 4, 5], [6, 7] * 4, list(range(1, 13)),
+           [9, 8, 7, 6, 5, 4, 3]]
+
+
+@pytest.fixture(scope="module")
+def serving_params():
+    return init_params(CFG, seed=0)
+
+
+def _engine(params, **kw):
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("prefill_budget", CFG.max_position)
+    kw.setdefault("clock", SimClock())
+    return ServingEngine(CFG, params, **kw)
+
+
+def _streams(params, prompts, **kw):
+    """Batched: one engine, all prompts in flight together."""
+    eng = _engine(params, **kw)
+    reqs = [eng.submit(p, max_new_tokens=3 + i)
+            for i, p in enumerate(prompts)]
+    eng.run()
+    return [list(r.generated) for r in reqs]
+
+
+def _streams_sequential(params, prompts, **kw):
+    """Sequential: a fresh engine per prompt, batch width 1."""
+    out = []
+    for i, p in enumerate(prompts):
+        eng = _engine(params, **kw)
+        r = eng.submit(p, max_new_tokens=3 + i)
+        eng.run()
+        out.append(list(r.generated))
+    return out
+
+
+@pytest.fixture(scope="module")
+def fp_control(serving_params):
+    """The tp=1 full-precision batched streams every full-precision
+    mode must reproduce bitwise."""
+    return _streams(serving_params, PROMPTS)
+
+
+# ---------------------------------------------------------------------------
+# tp-sharded decode: full-precision bitwise parity
+# ---------------------------------------------------------------------------
+
+
+class TestTensorParallel:
+    def test_tp2_matches_tp1_bitwise(self, serving_params, fp_control):
+        """THE tp acceptance pin: sharding attention heads over the
+        tensor axis reproduces the tp=1 streams token for token."""
+        assert _streams(serving_params, PROMPTS, tp=2) == fp_control
+
+    def test_tp2_batched_matches_sequential_bitwise(self, serving_params,
+                                                    fp_control):
+        # batched==sequential re-proven on the tp route (the PR 8
+        # criterion survives head sharding)
+        assert _streams_sequential(serving_params, PROMPTS, tp=2) \
+            == fp_control
+
+    def test_tp4_full_head_split_still_bitwise(self, serving_params,
+                                               fp_control):
+        # one head per shard: the degenerate split exercises the
+        # boundary collective hardest
+        assert _streams(serving_params, PROMPTS, tp=4) == fp_control
+
+    def test_tp_requires_divisible_heads(self, serving_params):
+        with pytest.raises(ValueError, match="not divisible"):
+            _engine(serving_params, tp=3)
+
+    def test_tp2_spec_and_chunked_still_bitwise(self, serving_params):
+        """The grown executable set (verify, chunked prefill) under tp
+        matches its own tp=1 control — speculation only ever commits
+        tokens the target model verifies, so tp must not change them."""
+        spec = SpecConfig(k=2, chunk_size=8)
+        ctrl = _streams(serving_params, PROMPTS, spec=spec)
+        assert _streams(serving_params, PROMPTS, spec=spec, tp=2) == ctrl
+
+
+# ---------------------------------------------------------------------------
+# quantized KV pool: narrow codes + scales, determinism parity bar
+# ---------------------------------------------------------------------------
+
+
+class TestQuantizedPool:
+    def test_pool_stores_int8_codes_and_fp32_scales(self, serving_params):
+        eng = _engine(serving_params, kv_quant="int8")
+        assert eng.cache.k.dtype == jnp.int8
+        assert eng.cache.v.dtype == jnp.int8
+        # one fp32 scale per (layer, page, slot, head): head_dim bytes
+        # of bf16 become head_dim int8 codes + 4 scale bytes
+        assert eng.cache.k_scale.dtype == jnp.float32
+        assert eng.cache.k_scale.shape == eng.cache.k.shape[:-1]
+
+    def test_quant_batched_matches_sequential_bitwise(self, serving_params):
+        """The quantized parity bar (docs/serving.md): the int8 route
+        is DETERMINISTIC — batched == sequential == re-run, bitwise
+        against ITSELF.  Equality with the full-precision streams is
+        deliberately NOT asserted: per-page re-scaling moves logits."""
+        got = _streams(serving_params, PROMPTS, kv_quant="int8")
+        assert _streams_sequential(serving_params, PROMPTS,
+                                   kv_quant="int8") == got
+        assert _streams(serving_params, PROMPTS, kv_quant="int8") == got
+        # the streams are real generations, same lengths as requested
+        assert [len(s) for s in got] == [3 + i for i in range(len(got))]
+
+    def test_quant_tp2_matches_quant_tp1_bitwise(self, serving_params):
+        # quantize-on-write happens per shard-local head slice with
+        # per-(slot, head) scales, so head sharding must not change
+        # the codes either: int8×tp2 == int8×tp1 bitwise
+        ctrl = _streams(serving_params, PROMPTS, kv_quant="int8")
+        assert _streams(serving_params, PROMPTS, kv_quant="int8",
+                        tp=2) == ctrl
+
+    def test_quant_roundtrip_error_is_bounded_and_measured(self):
+        """The documented half of the parity bar (docs/serving.md
+        "Parity bar (quantized)"): per-element int8 round-trip error is
+        bounded by scale/2 = absmax/(2·127) — ~0.4% of each token-
+        head's own absmax.  Measured here, on adversarial inputs
+        (mixed magnitudes per head), so the doc's number is pinned."""
+        from apex_tpu.serving.kv_cache import quantize_tokens
+
+        rng = np.random.RandomState(11)
+        x = jnp.asarray(rng.randn(64, 4, 32) *
+                        np.logspace(-3, 3, 64)[:, None, None],
+                        jnp.float32)
+        codes, scale = quantize_tokens(x, jnp.int8, 127.0)
+        back = codes.astype(jnp.float32) * scale[..., None]
+        absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+        err = jnp.max(jnp.abs(back - x) / absmax)
+        assert float(err) <= 1.0 / (2 * 127.0) + 1e-7
+        # zero rows stay exactly zero (absmax 0 -> scale 1)
+        zc, zs = quantize_tokens(jnp.zeros((2, 1, 8)), jnp.int8, 127.0)
+        assert jnp.all(zc == 0) and jnp.all(zs == 1.0)
+
+    def test_unknown_quant_mode_rejected(self, serving_params):
+        with pytest.raises(ValueError, match="unknown quantize"):
+            _engine(serving_params, kv_quant="int3")
+
+
+# ---------------------------------------------------------------------------
+# prefix sharing: refcounted pages, COW, eviction safety
+# ---------------------------------------------------------------------------
+
+
+def _unit_cache(**kw):
+    kw.setdefault("num_layers", 1)
+    kw.setdefault("num_pages", 8)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("head_dim", 4)
+    kw.setdefault("max_pages_per_request", 4)
+    return PagedKVCache(**kw)
+
+
+def _fill_page(cache, page, seed):
+    """Write one full page of distinct K/V content."""
+    T = cache.page_size
+    rng = np.random.RandomState(seed)
+    k = jnp.asarray(rng.randn(cache.num_layers, T, cache.num_heads,
+                              cache.head_dim), cache.dtype)
+    v = jnp.asarray(rng.randn(*k.shape), cache.dtype)
+    cache.write_tokens(k, v, np.full((T,), page, np.int32),
+                       np.arange(T, dtype=np.int32))
+
+
+class TestPrefixPages:
+    @pytest.mark.parametrize("quant", [None, "int8"])
+    def test_cow_gives_private_copy_and_preserves_content(self, quant):
+        cache = _unit_cache(quantize=quant)
+        [p] = cache.allocate(1, owner=1)
+        _fill_page(cache, p, seed=3)
+        cache.share([p])
+        assert cache.is_shared(p)
+        new = cache.cow(p, owner=2)
+        # the copy is a different page, both now private again
+        assert new != p
+        assert cache.refcount(p) == 1 and cache.refcount(new) == 1
+        assert jnp.array_equal(cache.k[:, new], cache.k[:, p])
+        assert jnp.array_equal(cache.v[:, new], cache.v[:, p])
+        if quant:
+            # scale planes move with the codes
+            assert jnp.array_equal(cache.k_scale[:, new],
+                                   cache.k_scale[:, p])
+        # writing into the private copy leaves the original untouched
+        before = cache.k[:, p]
+        _fill_page(cache, new, seed=4)
+        assert jnp.array_equal(cache.k[:, p], before)
+        assert not jnp.array_equal(cache.k[:, new], cache.k[:, p])
+
+    def test_cow_on_unshared_page_raises(self):
+        cache = _unit_cache()
+        [p] = cache.allocate(1, owner=1)
+        with pytest.raises(ValueError, match="unshared"):
+            cache.cow(p, owner=2)
+
+    def test_free_tail_refuses_shared_pages(self):
+        cache = _unit_cache()
+        pages = cache.allocate(2, owner=1)
+        cache.share([pages[1]])
+        with pytest.raises(ValueError, match="shared"):
+            cache.free_tail(pages, keep=1)
+        # the refusal left the page list and refcounts untouched
+        assert len(pages) == 2 and cache.refcount(pages[1]) == 2
+
+    def test_defrag_refuses_while_any_page_shared(self):
+        cache = _unit_cache()
+        pages = cache.allocate(2, owner=1)
+        cache.share(pages)
+        with pytest.raises(ValueError, match="defrag forbidden"):
+            cache.defrag([pages])
+
+    def test_share_of_free_page_refused(self):
+        cache = _unit_cache()
+        with pytest.raises(ValueError, match="unallocated"):
+            cache.share([3])
+
+    def test_shared_page_never_freed_while_second_reader_live(self):
+        """THE r17 eviction pin (PrefixIndex docstring): evicting an
+        index entry drops only the INDEX's reference — a page a live
+        request still reads survives eviction, retirement of the
+        original owner, everything, until its last reader frees it."""
+        cache = _unit_cache()
+        pages = cache.allocate(2, owner=1)
+        idx = PrefixIndex(cache, max_entries=1)
+        assert idx.register(list(range(1, 9)), pages)   # index: +1 each
+        cache.share(pages)                              # second reader
+        cache.free(pages)                               # owner retires
+        assert all(cache.refcount(p) == 2 for p in pages)
+        # capacity pressure evicts the entry; the live reader pins the
+        # pages — ZERO return to the free list
+        assert idx.evict_one() == 0
+        assert cache.pages_used == 2
+        assert all(cache.refcount(p) == 1 for p in pages)
+        # only the last reader's free returns them
+        cache.free(pages)
+        assert cache.pages_used == 0
+
+    def test_eviction_is_oldest_first_and_frees_unpinned_pages(self):
+        cache = _unit_cache(num_pages=16)
+        idx = PrefixIndex(cache, max_entries=2)
+        a = cache.allocate(1, owner=1)
+        idx.register(list(range(1, 5)), a)
+        cache.free(a)               # owner gone: index holds the last ref
+        b = cache.allocate(1, owner=2)
+        idx.register(list(range(11, 15)), b)
+        cache.free(b)
+        used = cache.pages_used
+        # third registration overflows capacity: the OLDEST entry (a)
+        # evicts, and with no other reader its page really frees
+        c = cache.allocate(1, owner=3)
+        idx.register(list(range(21, 25)), c)
+        cache.free(c)
+        assert idx.entries[0] == tuple(range(11, 15))
+        assert cache.pages_used == used  # -1 (a freed) +1 (c pinned)
+
+    def test_register_rejects_wrong_page_footprint(self):
+        cache = _unit_cache()
+        pages = cache.allocate(2, owner=1)
+        with pytest.raises(ValueError, match="register"):
+            PrefixIndex(cache).register(list(range(1, 5)), pages)
+
+    def test_prefix_sharing_requires_chunked_prefill(self, serving_params):
+        # the shared prefix skips prefill for covered tokens; only the
+        # chunked path can prefill an arbitrary-length suffix
+        with pytest.raises(ValueError, match="chunk"):
+            _engine(serving_params, prefix_sharing=True)
+
+
+class TestPrefixSharingEngine:
+    SPEC = SpecConfig(k=0, chunk_size=8)
+    #: 12 tokens = one full page + 4: the retired first request
+    #: registers its aligned 16-token context, so the repeat's lookup
+    #: covers 11 tokens — ending MID-PAGE, which forces a COW before
+    #: the suffix chunk writes
+    PROMPT = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]
+
+    def _serve_twice(self, params, **kw):
+        from apex_tpu import telemetry as tel
+
+        mem = tel.MemorySink()
+        bus = tel.TelemetryBus(run_id="pfx", sinks=[mem])
+        eng = _engine(params, spec=self.SPEC, prefix_sharing=True,
+                      telemetry=bus, **kw)
+        r1 = eng.submit(list(self.PROMPT), max_new_tokens=6)
+        eng.run()
+        r2 = eng.submit(list(self.PROMPT), max_new_tokens=6)
+        eng.run()
+        return eng, r1, r2, mem
+
+    def test_repeat_prompt_hits_and_streams_stay_bitwise(
+            self, serving_params):
+        """Prefix sharing is a placement optimization: the repeat
+        admission skips prefill for the shared tokens, COWs the
+        mid-page boundary, and still produces the unshared control's
+        exact streams."""
+        ctrl = _engine(serving_params, spec=self.SPEC)
+        c1 = ctrl.submit(list(self.PROMPT), max_new_tokens=6)
+        ctrl.run()
+        c2 = ctrl.submit(list(self.PROMPT), max_new_tokens=6)
+        ctrl.run()
+
+        eng, r1, r2, _ = self._serve_twice(serving_params)
+        assert r1.prefix_hit is False and r2.prefix_hit is True
+        assert list(r1.generated) == list(c1.generated)
+        assert list(r2.generated) == list(c2.generated)
+        # both retired: every page back except the index's warm prefix
+        assert eng.cache.pages_used == len(eng.prefix_index.entries) \
+            and len(eng.prefix_index) > 0
+
+    def test_prefix_telemetry_fields(self, serving_params):
+        """Satellite 1 wiring: every admit under sharing carries the
+        prefix_hit BOOL (misses too — the denominator), and decode
+        steps report the pool_shared_pages INT count."""
+        from apex_tpu import telemetry as tel
+
+        _, _, _, mem = self._serve_twice(serving_params)
+        admits = [e for e in mem.events if e["type"] == "request_admit"]
+        assert [e["prefix_hit"] for e in admits] == [False, True]
+        assert all(type(e["prefix_hit"]) is bool for e in admits)
+        shared = [e["pool_shared_pages"] for e in mem.events
+                  if e["type"] == "decode_step"]
+        assert all(type(s) is int for s in shared)
+        assert max(shared) >= 1     # the repeat really decoded shared
+        for e in mem.events:
+            tel.validate_event(e)
+
+    def test_sharing_composes_with_tp_and_quant(self, serving_params):
+        # the full r17 stack at once; quantized, so the bar is the
+        # engine's OWN unshared int8 control, not the fp streams
+        ctrl = _engine(serving_params, spec=self.SPEC, tp=2,
+                       kv_quant="int8")
+        c1 = ctrl.submit(list(self.PROMPT), max_new_tokens=6)
+        ctrl.run()
+        c2 = ctrl.submit(list(self.PROMPT), max_new_tokens=6)
+        ctrl.run()
+        _, r1, r2, _ = self._serve_twice(serving_params, tp=2,
+                                         kv_quant="int8")
+        assert r2.prefix_hit is True
+        assert list(r1.generated) == list(c1.generated)
+        assert list(r2.generated) == list(c2.generated)
+
+
+# ---------------------------------------------------------------------------
+# resilience on the grown modes: recovery, snapshot/restore, guard
+# ---------------------------------------------------------------------------
+
+
+def _trace_streams(eng, prompts):
+    reqs = [eng.submit(p, max_new_tokens=3 + i)
+            for i, p in enumerate(prompts)]
+    eng.run()
+    return [list(r.generated) for r in reqs]
+
+
+class TestModesResilience:
+    MODES = dict(tp=2, kv_quant="int8")
+
+    def test_kill_mid_decode_recovers_bitwise_on_tp_quant(
+            self, serving_params):
+        """Kill-mid-decode on the tp=2 + int8 engine: rebuild +
+        deterministic re-prefill RE-QUANTIZES the same codes, so the
+        recovered streams equal the uninterrupted control's bitwise
+        (at the quantized route's own parity bar — control is int8)."""
+        ctrl = _trace_streams(_engine(serving_params, **self.MODES),
+                              PROMPTS)
+        with chaos.ServingDeviceLoss(at_step=3, device_ids=[0]) as dl:
+            eng = _engine(serving_params, **self.MODES)
+            got = _trace_streams(eng, PROMPTS)
+        assert dl.fired and eng.recoveries == 1
+        assert got == ctrl
+
+    def test_snapshot_restore_round_trip_tp_quant(self, serving_params):
+        """snapshot → JSON → restore into a fresh tp=2 + int8 engine
+        whose code AND scale pools are sentinel-poisoned → continue:
+        the control's streams.  Proves restore re-derives every
+        quantized byte from tokens alone."""
+        ctrl = _trace_streams(_engine(serving_params, **self.MODES),
+                              PROMPTS)
+        src = _engine(serving_params, **self.MODES)
+        reqs = [src.submit(p, max_new_tokens=3 + i)
+                for i, p in enumerate(PROMPTS)]
+        for _ in range(4):
+            src.step()
+        snap = json.loads(json.dumps(src.snapshot()))
+        dst = _engine(serving_params, **self.MODES)
+        dst.cache.k = jnp.full_like(dst.cache.k, 101)
+        dst.cache.v = jnp.full_like(dst.cache.v, 102)
+        dst.cache.k_scale = jnp.full_like(dst.cache.k_scale, 1e3)
+        dst.cache.v_scale = jnp.full_like(dst.cache.v_scale, 1e3)
+        restored = dst.restore(snap)
+        dst.run()
+        assert restored     # the cut really caught live requests
+        # same submission order → same rids on the control engine
+        ctrl_by_rid = dict(enumerate(ctrl))
+        for r in restored:
+            assert list(r.generated) == ctrl_by_rid[r.rid], r.rid
+
+    def test_zero_compiles_after_warmup_all_modes(self, serving_params):
+        """The compiled-shapes contract over the FULL r17 executable
+        set: tp=2 shard_map steps, quantize-on-write scatter, verify +
+        chunked prefill, and the COW page copy — a shared-prefix
+        admission after warmup compiles NOTHING."""
+        from apex_tpu.analysis import HotPathViolation  # noqa: F401
+
+        eng = _engine(serving_params, tp=2, kv_quant="int8",
+                      prefix_sharing=True,
+                      spec=SpecConfig(k=2, chunk_size=8))
+        eng.warmup()
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]
+        with hot_path_guard("r17 serving lifetime",
+                            transfers=None) as g:
+            r1 = eng.submit(list(prompt), max_new_tokens=6)
+            eng.run()
+            r2 = eng.submit(list(prompt), max_new_tokens=6)
+            eng.run()
+        assert r2.prefix_hit is True        # the COW path really ran
+        assert len(r1.generated) == len(r2.generated) == 6
+        assert g.recompiles == 0 and g.syncs == []
+
+
+# ---------------------------------------------------------------------------
+# the heavy parity grid (slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("page_size", [4, 8])
+@pytest.mark.parametrize("quant", [None, "int8"])
+@pytest.mark.parametrize("tp", [1, 2, 4])
+def test_parity_grid_batched_matches_sequential(serving_params, tp, quant,
+                                                page_size):
+    """tp × quant × page-size sweep: every cell holds batched ==
+    sequential bitwise, and every full-precision cell additionally
+    reproduces the tp=1 fp streams (page size is pool layout only)."""
+    kw = dict(tp=tp, kv_quant=quant, page_size=page_size)
+    got = _streams(serving_params, PROMPTS, **kw)
+    assert _streams_sequential(serving_params, PROMPTS, **kw) == got
+    if quant is None:
+        assert got == _streams(serving_params, PROMPTS,
+                               page_size=page_size)

@@ -133,6 +133,29 @@ def test_schema_validator_rejects_malformed_events():
         tele.validate_event(skip)
 
 
+def test_r17_prefix_fields_pin_bool_vs_int():
+    """r17 satellite: ``request_admit.prefix_hit`` is a REAL bool (an
+    int hit-COUNT would silently satisfy a sloppier spec and break the
+    summarize denominator), ``decode_step.pool_shared_pages`` is a
+    REAL int count (a bool would cap the gauge at 1) — and both are
+    optional, so pre-r17 event streams still validate."""
+    stamp = {"run_id": "r", "step": None, "t": 0.1, "ts": 1.0, "mesh": {}}
+    admit = dict(stamp, type="request_admit", rid=0, context_tokens=9,
+                 pages=2, preemptions=0)
+    tele.validate_event(admit)                          # absent: sharing off
+    tele.validate_event(dict(admit, prefix_hit=True))
+    tele.validate_event(dict(admit, prefix_hit=False))  # misses emit too
+    with pytest.raises(tele.SchemaError, match="prefix_hit must be bool"):
+        tele.validate_event(dict(admit, prefix_hit=1))
+    step = dict(stamp, type="decode_step", batch=1, new_tokens=1,
+                pool_used=3, pool_pages=63)
+    tele.validate_event(step)                           # absent: sharing off
+    tele.validate_event(dict(step, pool_shared_pages=0))
+    tele.validate_event(dict(step, pool_shared_pages=24))
+    with pytest.raises(tele.SchemaError, match="got bool"):
+        tele.validate_event(dict(step, pool_shared_pages=True))
+
+
 def test_emit_survives_sink_failure():
     """Observability must never kill the run it observes: a sink whose
     write raises (ENOSPC, broken pipe) is dropped, the event still
