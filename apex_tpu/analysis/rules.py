@@ -83,6 +83,12 @@ HOT_PATH_FUNCTIONS: Dict[str, Set[str]] = {
     # device pull here stalls EVERY replica, not one
     "apex_tpu/serving/fleet/router.py": {
         "route", "_migrate_requests", "_health_check"},
+    # r18: every cross-replica payload serializes/delivers through the
+    # transport, and the disaggregation pump drives page shipments
+    # every fleet round — pure host json/zlib/base64 work; a device
+    # pull here would stall the whole fleet per message
+    "apex_tpu/serving/fleet/transport.py": {"call", "deliver"},
+    "apex_tpu/serving/fleet/disagg.py": {"_pump_disagg", "_drive"},
     "apex_tpu/transformer/testing/train_loop.py": {
         "run_resilient_training"},
     "apex_tpu/resilience/elastic.py": {"run_elastic_training"},

@@ -99,10 +99,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from apex_tpu.serving.kv_cache import (PagedKVCache, PagePoolCorruption,
-                                       PrefixIndex)
+                                       PagePoolExhausted, PrefixIndex,
+                                       verify_page_payload)
 from apex_tpu.serving.model import (PagedDecoder, ServingModelConfig,
                                     init_params, shard_params_tp)
-from apex_tpu.serving.scheduler import (FINISHED, WAITING,
+from apex_tpu.serving.scheduler import (FINISHED, RUNNING, WAITING,
                                         ContinuousBatchingScheduler,
                                         QueueFullError, Request)
 from apex_tpu.serving.spec import (NgramProposer, SpecConfig,
@@ -117,6 +118,17 @@ from apex_tpu.serving.spec import (NgramProposer, SpecConfig,
 #: disagree on the set.
 SERVING_EXECUTABLES = ("prefill", "decode", "admission_scatter",
                        "verify", "chunk")
+
+
+class AdmissionRefused(RuntimeError):
+    """A shipped-prefill admission (:meth:`ServingEngine.
+    adopt_prefilled`) was refused for CAPACITY — no decode batch slot
+    or no pool pages.  Recoverable by construction, like
+    :class:`~apex_tpu.serving.kv_cache.PagePoolExhausted`: the sender
+    backs off and retries, or past its budget falls back to migrating
+    the request for local prefill.  Validation failures (geometry, rid
+    collision, CRC) raise ``ValueError`` instead — those are bugs or
+    corruption, not capacity events."""
 
 
 # -- chaos hook (ISSUE 10) ---------------------------------------------------
@@ -243,7 +255,9 @@ class ServingEngine:
                  tp: int = 1,
                  kv_quant: Optional[str] = None,
                  prefix_sharing: bool = False,
-                 prefix_entries: int = 8):
+                 prefix_entries: int = 8,
+                 prefill_only: bool = False,
+                 kv_import: bool = False):
         self.cfg = cfg
         self.params = params if params is not None else init_params(cfg, seed)
         self.prefill_budget = (cfg.max_position if prefill_budget is None
@@ -324,6 +338,14 @@ class ServingEngine:
         # reason="unservable"), not a ValueError — default off keeps
         # the single-engine caller-bug contract
         self.reject_unservable = bool(reject_unservable)
+        # r18 disaggregation roles (docs/serving.md "Disaggregated
+        # prefill/decode"): a prefill_only engine admits and
+        # (chunk-)prefills but never decodes — its requests leave via
+        # export_request; kv_import warms the shipped-page import
+        # executable so adopt_prefilled never compiles on the
+        # admission path.  Both off is the colocated engine, bit-for-bit.
+        self.prefill_only = bool(prefill_only)
+        self.kv_import = bool(kv_import)
         self.recoveries = 0
         self.rejected: List[Request] = []
         self._next_rid = 0
@@ -708,6 +730,16 @@ class ServingEngine:
             # the COW page copy — on the admission path; warm it too so
             # the first shared-prefix hit compiles nothing
             self.cache.warm_copy()
+        if self.kv_import:
+            # r18: a decode replica lands shipped pages through one
+            # more executable — warm it so the first inbound shipment
+            # compiles nothing (the chaos_disagg zero-recompile pin)
+            self.cache.warm_import()
+        if self.prefill_only:
+            # ... and a prefill replica reads pages OUT through a
+            # device-side page-slice gather; warm that too, for the
+            # same zero-recompile pin on the export side
+            self.cache.warm_export()
         jax.block_until_ready(self.cache.k)
         return time.perf_counter() - t0
 
@@ -1071,7 +1103,7 @@ class ServingEngine:
         progress = bool(self._retire(now)) or progress
         evicted: List[Request] = []
         drafts: Dict[int, List[int]] = {}
-        if self.sched.running:
+        if self.sched.running and not self.prefill_only:
             if self.proposer is not None:
                 drafts = self._propose_drafts()
             # growth covers each drafted row's verify footprint too
@@ -1081,7 +1113,10 @@ class ServingEngine:
             evicted = self.sched.ensure_decode_capacity(
                 extra={rid: len(d) for rid, d in drafts.items()}
                 or None)
-        rows = [r for r in self.sched.running if r.prefill_pos is None]
+        # a prefill_only engine never decodes: finished prefills hold
+        # their first token and wait for export_request to ship them
+        rows = ([] if self.prefill_only else
+                [r for r in self.sched.running if r.prefill_pos is None])
         if rows:
             t0 = self.clock()
             spec_fields = {}
@@ -1274,6 +1309,121 @@ class ServingEngine:
                 req.state = WAITING
                 self.sched.waiting.append(req)
         return adopted
+
+    # -- disaggregated prefill/decode (r18) --------------------------------
+
+    def export_request(self, rid: int):
+        """Detach a freshly prefilled request for shipping (the
+        prefill-replica side of r18 disaggregation): serialize its KV
+        pages (:meth:`PagedKVCache.export_page_bytes` — per-page CRC
+        stamped at export), capture its snapshot-format record
+        (first token included in ``generated``), then release its
+        local footprint.  Returns ``(record, pages_payload, kv_len)``.
+
+        The request must be RUNNING with prefill complete
+        (``prefill_pos is None``) and hold its first token — i.e. it
+        is exactly at the point where a colocated engine would start
+        decoding.  Locally it finishes as ``"shipped"`` (NOT counted
+        in ``sched.finished`` — it retires for real on the decode
+        replica); the caller's handle on the DECODE replica is the
+        live one after adoption."""
+        req = next((r for r in self.sched.running if r.rid == rid), None)
+        if req is None:
+            raise ValueError(f"export_request: rid {rid} is not running")
+        if req.prefill_pos is not None or not req.generated:
+            raise ValueError(
+                f"export_request: rid {rid} has not finished prefill")
+        pages_payload = [self.cache.export_page_bytes(p)
+                         for p in req.pages]
+        record = {
+            "rid": req.rid,
+            "prompt": list(req.prompt),
+            "max_new_tokens": req.max_new_tokens,
+            "eos_id": req.eos_id,
+            "arrival_t": req.arrival_t,
+            "deadline_s": req.deadline_s,
+            "generated": list(req.generated),
+            "preemptions": req.preemptions,
+            "admit_t": req.admit_t,
+            "first_token_t": req.first_token_t,
+            "was_running": True,
+        }
+        kv_len = req.kv_len
+        self.sched.running.remove(req)
+        self.cache.free(req.pages)
+        req.pages = []
+        req.kv_len = 0
+        req.state = FINISHED
+        req.finish_reason = "shipped"
+        if self.proposer is not None:
+            self.proposer.release(req.rid)
+        return record, pages_payload, kv_len
+
+    def adopt_prefilled(self, record: Dict[str, Any],
+                        pages_payload: Sequence[Dict[str, Any]],
+                        kv_len: int) -> Request:
+        """Admit one SHIPPED prefill straight into the decode batch
+        (the decode-replica side of r18): re-verify each page payload
+        host-side, allocate local pages, land the bytes verbatim, and
+        enter RUNNING with the source's token state — decode proceeds
+        as if this engine had prefilled locally, bitwise.
+
+        Validation is atomic, in the :meth:`adopt` discipline —
+        geometry, rid collision, page-count arithmetic, and per-page
+        CRC all checked before any state mutates (a corrupted page is
+        NEVER adopted; the sender re-ships it).  Capacity refusals
+        (no decode batch slot, no pool pages) raise
+        :class:`AdmissionRefused` — retryable, leaving the engine
+        untouched."""
+        kv_len = int(kv_len)
+        req = Request(
+            rid=int(record["rid"]), prompt=list(record["prompt"]),
+            max_new_tokens=int(record["max_new_tokens"]),
+            eos_id=record["eos_id"], arrival_t=float(record["arrival_t"]),
+            deadline_s=record["deadline_s"])
+        req.generated = list(record["generated"])
+        req.preemptions = int(record["preemptions"])
+        req.admit_t = record["admit_t"]
+        req.first_token_t = record["first_token_t"]
+        self.sched.check_servable(req)
+        live_rids = ({q.rid for q in self.sched.running}
+                     | {q.rid for q in self.sched.waiting})
+        if req.rid in live_rids:
+            raise ValueError(
+                f"adopt_prefilled: rid {req.rid} collides with a live "
+                "request — shipping requires a fleet-global rid "
+                "namespace")
+        need = self.cache.pages_needed(kv_len)
+        if len(pages_payload) != need:
+            raise ValueError(
+                f"adopt_prefilled: rid {req.rid} shipped "
+                f"{len(pages_payload)} pages for kv_len {kv_len} "
+                f"(expected {need})")
+        for i, data in enumerate(pages_payload):
+            if not verify_page_payload(data):
+                raise ValueError(
+                    f"adopt_prefilled: rid {req.rid} page {i} failed "
+                    "CRC verification — corrupted in flight, refusing "
+                    "to adopt")
+        if len(self.sched.running) >= self.max_batch:
+            raise AdmissionRefused(
+                f"adopt_prefilled: decode batch full "
+                f"({len(self.sched.running)}/{self.max_batch})")
+        try:
+            pages = self.cache.allocate(need, req.rid)
+        except PagePoolExhausted as e:
+            raise AdmissionRefused(str(e)) from e
+        for page, data in zip(pages, pages_payload):
+            self.cache.import_page_bytes(page, data)
+        req.pages = pages
+        req.kv_len = kv_len
+        req.state = RUNNING
+        self.sched.running.append(req)
+        self._next_rid = max(self._next_rid, req.rid + 1)
+        self._emit("request_admit", rid=req.rid,
+                   context_tokens=kv_len, pages=len(pages),
+                   preemptions=req.preemptions)
+        return req
 
     def _finish_restored(self, req: Request) -> None:
         """Retire a request that was already done when the crash hit

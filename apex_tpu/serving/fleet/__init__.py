@@ -18,11 +18,29 @@ Three modules, outside-in:
   and geometry-validated before any engine mutates, loud
   :class:`FleetCapacityError` instead of silent drops.
 
-See docs/serving.md "Fleet tier" for the router policy, the migration
-contract (what is and isn't bitwise), and the fence/backoff state
-machine.
+r18 adds two more, underneath and on top:
+
+* :mod:`~apex_tpu.serving.fleet.transport` — the message-level seam
+  every cross-replica payload (pings, migration snapshots, KV page
+  shipments) flows through: :class:`LocalTransport` (in-process,
+  RPC-shaped: serialize → deliver → deserialize with per-message ids)
+  and :class:`ChaosTransport` (per-message-class drop / delay /
+  duplicate / reorder / corrupt injection).
+* :mod:`~apex_tpu.serving.fleet.disagg` — disaggregated
+  prefill/decode: :class:`DisaggRouter` ships finished prefills' KV
+  pages from prefill replicas to decode replicas (idempotent,
+  resumable, CRC-verified, retried with backoff, falling back to
+  local prefill past the budget — zero dropped requests).
+
+See docs/serving.md "Fleet tier" / "Disaggregated prefill/decode" for
+the router policy, the migration and shipment contracts (what is and
+isn't bitwise), and the fence/backoff state machine.
 """
 
+from apex_tpu.serving.fleet.disagg import (  # noqa: F401
+    DisaggRouter,
+    PageImporter,
+)
 from apex_tpu.serving.fleet.migrate import (  # noqa: F401
     FleetCapacityError,
     plan_migration,
@@ -43,6 +61,14 @@ from apex_tpu.serving.fleet.router import (  # noqa: F401
     scale_hint,
     scale_hint_from_events,
 )
+from apex_tpu.serving.fleet.transport import (  # noqa: F401
+    ChaosTransport,
+    LocalTransport,
+    Transport,
+    TransportCorruption,
+    TransportTimeout,
+    register_error,
+)
 
 __all__ = [
     "FleetRouter",
@@ -59,4 +85,12 @@ __all__ = [
     "RESTARTING",
     "FleetCapacityError",
     "plan_migration",
+    "Transport",
+    "LocalTransport",
+    "ChaosTransport",
+    "TransportTimeout",
+    "TransportCorruption",
+    "register_error",
+    "DisaggRouter",
+    "PageImporter",
 ]

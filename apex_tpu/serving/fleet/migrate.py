@@ -10,9 +10,10 @@ the snapshot is intact, and the operator sees exactly which request
 did not fit.
 
 Records travel in the engine's snapshot format (format 1, host-only,
-JSON-serializable by construction); the router round-trips the
-snapshot through ``json`` before planning, so the in-process fast path
-exercises the same serialization a process/RPC boundary will.
+JSON-serializable by construction); since r18 they ship to each
+target through the fleet transport's serialize → deliver →
+deserialize pipeline, so the in-process fast path exercises exactly
+the serialization a process/RPC boundary will.
 """
 
 from __future__ import annotations
@@ -21,7 +22,23 @@ from typing import Any, Dict, List, Sequence
 
 
 class FleetCapacityError(RuntimeError):
-    """No healthy target can take a live migrating request."""
+    """No healthy target can take one or more live migrating requests.
+
+    Carries the FULL refusal shape (r18 satellite), not just the first
+    failure: ``unplaceable`` lists every rid that fit no target,
+    ``pages_required`` the pool pages their worst-case footprints
+    need, ``pages_available`` the free pages across the candidate
+    targets — the numbers an operator sizes capacity from (the router
+    also emits them on a ``migrate_refused`` event)."""
+
+    def __init__(self, msg: str, *,
+                 unplaceable: Sequence[int] = (),
+                 pages_required: int = 0,
+                 pages_available: int = 0):
+        super().__init__(msg)
+        self.unplaceable = list(unplaceable)
+        self.pages_required = int(pages_required)
+        self.pages_available = int(pages_available)
 
 
 def _servable_by(target, record: Dict[str, Any]) -> bool:
@@ -51,17 +68,22 @@ def plan_migration(records: Sequence[Dict[str, Any]],
     Done-at-capture records retire immediately on adoption (they never
     enter the waiting queue), so they don't consume headroom; live
     records do.  Assignment order is rid order for determinism."""
+    done = [r for r in records if _record_done(r)]
+    live = [r for r in records if not _record_done(r)]
     if not targets:
         raise FleetCapacityError(
-            f"no healthy targets for {len(records)} migrating requests")
+            f"no healthy targets for {len(records)} migrating requests",
+            unplaceable=[int(r["rid"]) for r in
+                         sorted(live, key=lambda r: int(r["rid"]))])
     plan: Dict[str, List[Dict[str, Any]]] = {t.name: [] for t in targets}
     headroom = {t.name: t.queue_headroom() for t in targets}
     # fractional load tiebreak frozen at plan time; planned placements
     # added on top so a burst spreads instead of piling on one target
     load = {t.name: t.load_score() for t in targets}
     by_name = {t.name: t for t in targets}
-    done = [r for r in records if _record_done(r)]
-    live = [r for r in records if not _record_done(r)]
+    # a refused plan reports EVERY request that fit nowhere, not just
+    # the first — one fence, one error, the complete capacity gap
+    unplaceable: List[Dict[str, Any]] = []
     for rec in sorted(live, key=lambda r: int(r["rid"])):
         candidates = [
             n for n, t in by_name.items()
@@ -69,15 +91,28 @@ def plan_migration(records: Sequence[Dict[str, Any]],
             and _servable_by(t, rec)
         ]
         if not candidates:
-            raise FleetCapacityError(
-                f"request {rec['rid']} fits no healthy target "
-                f"(headroom {dict(headroom)}) — refuse the whole plan, "
-                "drop nothing")
+            unplaceable.append(rec)
+            continue
         name = min(candidates, key=lambda n: (load[n], n))
         plan[name].append(rec)
         load[name] += 1
         if headroom[name] is not None:
             headroom[name] -= 1
+    if unplaceable:
+        rids = [int(r["rid"]) for r in unplaceable]
+        required = sum(
+            min(t.engine.cache.pages_needed(
+                len(r["prompt"]) + int(r["max_new_tokens"]))
+                for t in targets)
+            for r in unplaceable)
+        available = sum(t.engine.cache.pages_free for t in targets)
+        raise FleetCapacityError(
+            f"{len(rids)} of {len(live)} migrating requests fit no "
+            f"healthy target (rids {rids}; worst-case pages required "
+            f"{required}, free across targets {available}; headroom "
+            f"{dict(headroom)}) — refuse the whole plan, drop nothing",
+            unplaceable=rids, pages_required=required,
+            pages_available=available)
     for rec in sorted(done, key=lambda r: int(r["rid"])):
         name = min(by_name, key=lambda n: (load[n], n))
         plan[name].append(rec)

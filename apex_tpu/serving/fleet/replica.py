@@ -80,10 +80,21 @@ class ReplicaProxy:
     at the RPC boundary.
     """
 
-    def __init__(self, name: str, engine_factory, *, telemetry=None):
+    def __init__(self, name: str, engine_factory, *, telemetry=None,
+                 role: str = "mixed"):
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(f"unknown replica role {role!r} "
+                             "(expected mixed / prefill / decode)")
         self.name = name
         self.engine_factory = engine_factory
         self.telemetry = telemetry
+        #: r18 disaggregation role axis: "mixed" replicas do everything
+        #: (the pre-r18 fleet); "prefill" replicas only admit +
+        #: chunk-prefill (their engines are ``prefill_only``); "decode"
+        #: replicas receive shipped pages (``kv_import``) and decode.
+        #: The role is a PLACEMENT attribute — the proxy itself treats
+        #: every engine identically.
+        self.role = role
         self.engine = engine_factory()
         self.state = HEALTHY
         #: router-level retry budget consumed (engine-level recovery
@@ -200,3 +211,19 @@ class ReplicaProxy:
 
     def adopt(self, records: List[Dict[str, Any]]):
         return self.engine.adopt(records)
+
+    def find_request(self, rid: int):
+        """This replica's live :class:`Request` handle for ``rid``
+        (running, waiting, or finished), or ``None``.  The rebinding
+        step after a transport-mediated transfer: the wire carries
+        records, not handles, so after a migrate/ship reply the router
+        looks the adopted request up by rid to hand the caller a live
+        handle."""
+        rid = int(rid)
+        for pool in (self.engine.sched.running,
+                     self.engine.sched.waiting,
+                     self.engine.sched.finished):
+            for req in pool:
+                if req.rid == rid:
+                    return req
+        return None

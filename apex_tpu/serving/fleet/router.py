@@ -19,14 +19,18 @@
   propagate to the router, which retries the replica with exponential
   round backoff up to ``fault_retries`` before FENCING it: out of
   rotation, ``replica_fence`` emitted, live requests migrated.
-* **Migration** — ``snapshot()`` → JSON round-trip (the
-  serializability pin for the later RPC boundary) →
-  :func:`~apex_tpu.serving.fleet.migrate.plan_migration` →
-  ``adopt()`` per target.  Atomic at both levels (plan refuses whole,
-  adopt validates before mutating); every hop is a
-  ``request_migrate`` event; zero silent drops.  Migrated streams are
-  bitwise the unmigrated control's — KV is rebuilt by deterministic
-  re-prefill, exactly the single-engine recovery contract.
+* **Migration** — ``snapshot()`` →
+  :func:`~apex_tpu.serving.fleet.migrate.plan_migration` → one
+  transport ``migrate`` message per target (r18: the transport's
+  serialize → deliver → deserialize pipeline IS the serializability
+  pin the old inline JSON round-trip carried), adopted by an
+  idempotent rid-deduping handler.  Atomic at both levels (plan
+  refuses whole — with the full unplaceable list on
+  ``migrate_refused`` — and adopt validates before mutating); every
+  hop is a ``request_migrate`` event; zero silent drops.  Migrated
+  streams are bitwise the unmigrated control's — KV is rebuilt by
+  deterministic re-prefill, exactly the single-engine recovery
+  contract.
 * **Rolling restart** — :func:`rolling_restart` drains, migrates,
   restarts and readmits one replica at a time; a fleet of one
   readmits its own snapshot after the restart (nothing to migrate
@@ -49,11 +53,21 @@ import json
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
-from apex_tpu.serving.fleet.migrate import plan_migration
+from apex_tpu.serving.fleet.migrate import (FleetCapacityError,
+                                            plan_migration)
 from apex_tpu.serving.fleet.replica import (FENCED, HealthCheckTimeout,
                                             ReplicaProxy)
+from apex_tpu.serving.fleet.transport import (LocalTransport, Transport,
+                                              TransportCorruption,
+                                              TransportTimeout,
+                                              register_error)
 from apex_tpu.serving.kv_cache import PagePoolCorruption
 from apex_tpu.serving.scheduler import Request
+
+# the one replica-owned exception that legitimately crosses the
+# transport as a typed error reply (a ping probe timing out on the
+# REMOTE side must re-raise as itself on the router side)
+register_error(HealthCheckTimeout)
 
 
 @dataclass(frozen=True)
@@ -129,7 +143,8 @@ class FleetRouter:
                  fault_retries: int = 2,
                  health_timeout_s: float = 0.25,
                  scale_hint_every: int = 50,
-                 on_round: Optional[Callable[[], None]] = None):
+                 on_round: Optional[Callable[[], None]] = None,
+                 transport: Optional[Transport] = None):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
         names = [r.name for r in replicas]
@@ -138,6 +153,22 @@ class FleetRouter:
         self.replicas: List[ReplicaProxy] = list(replicas)
         self._by_name = {r.name: r for r in self.replicas}
         self.telemetry = telemetry
+        # r18: EVERY cross-replica payload — health pings, migration
+        # snapshots, KV page shipments — goes through the transport
+        # seam (serialize → deliver → deserialize, per-message ids).
+        # Default is the plain in-process LocalTransport; tests wrap it
+        # in ChaosTransport to lose/delay/duplicate/reorder/corrupt
+        # messages in flight.
+        self.transport = transport if transport is not None \
+            else LocalTransport()
+        for rep in self.replicas:
+            self.transport.register(
+                rep.name, "ping",
+                lambda p, rep=rep:
+                    {"latency_s": rep.ping(float(p["timeout_s"]))})
+            self.transport.register(
+                rep.name, "migrate",
+                lambda p, rep=rep: self._migrate_handler(rep, p))
         self.slo_classes = {c.name: c for c in slo_classes}
         self.fault_retries = int(fault_retries)
         self.health_timeout_s = float(health_timeout_s)
@@ -178,7 +209,7 @@ class FleetRouter:
                     f"unknown SLO class {slo!r}; registered: "
                     f"{sorted(self.slo_classes)}")
             deadline_s = cls.deadline_s
-        rep = self.route()
+        rep = self.route(prompt=prompt)
         rid = self._next_rid
         self._next_rid += 1
         req = Request(rid=rid, prompt=list(prompt),
@@ -191,34 +222,107 @@ class FleetRouter:
         self.placement[rid] = rep.name
         return rid
 
-    def route(self) -> ReplicaProxy:
+    def route(self, prompt: Optional[Sequence[int]] = None,
+              roles: Optional[Sequence[str]] = None) -> ReplicaProxy:
         """Pick the least-loaded healthy replica, preferring ones with
         bounded-queue headroom; with every queue full the least-loaded
         healthy replica takes the submission and its engine rejects
         loudly (backpressure stays ONE policy, the engine's).  Raises
         when no replica is healthy — a dead fleet is not a routing
-        decision."""
+        decision.
+
+        ``roles`` restricts the candidates to the named replica roles
+        (the r18 disaggregation axis; ``None`` considers everyone).
+        ``prompt`` enables PREFIX AFFINITY (r18 satellite): among the
+        candidate pool, replicas whose local
+        :class:`~apex_tpu.serving.kv_cache.PrefixIndex` already holds
+        a usable prefix of the prompt are preferred — the deepest hit
+        wins, least-loaded tiebreak — so repeated prompts land where
+        their pages are already warm instead of re-prefilling cold on
+        a less-loaded peer.  No index state is shipped or shared: the
+        affinity reads each replica's existing local hit signal, and a
+        fleet with no sharing enabled routes exactly as before."""
         healthy = [r for r in self.replicas if r.healthy]
+        if roles is not None:
+            healthy = [r for r in healthy if r.role in roles]
         if not healthy:
-            raise RuntimeError("no healthy replicas in the fleet")
+            raise RuntimeError(
+                "no healthy replicas in the fleet" if roles is None else
+                f"no healthy replica with role in {tuple(roles)}")
         with_room = [r for r in healthy
                      if r.queue_headroom() is None or r.queue_headroom() > 0]
         pool = with_room or healthy
+        if prompt is not None:
+            hits = {}
+            for r in pool:
+                idx = r.engine.prefix_index
+                if idx is not None:
+                    m, _ = idx.lookup(list(prompt))
+                    if m > 0:
+                        hits[r.name] = m
+            if hits:
+                best = max(hits.values())
+                pool = [r for r in pool if hits.get(r.name) == best]
         return min(pool, key=lambda r: (r.load_score(), r.name))
 
     # -- health + fencing ------------------------------------------------
 
     def _health_check(self) -> None:
-        """Probe every in-rotation replica; a timeout fences it on the
-        spot and reroutes its live requests — the router NEVER blocks
-        on a black hole (the probe is virtual-latency, no sleep)."""
+        """Probe every in-rotation replica THROUGH the transport; a
+        probe timing out remotely, or the probe message itself lost /
+        late / corrupted in flight, fences the replica on the spot and
+        reroutes its live requests — an unreachable replica and an
+        unhealthy one get the same treatment, because the router
+        cannot tell them apart (and must not block finding out: the
+        probe is virtual-latency, no sleep)."""
         for rep in self.replicas:
             if not rep.healthy:
                 continue
             try:
-                rep.ping(self.health_timeout_s)
+                self.transport.call(rep.name, "ping",
+                                    {"timeout_s": self.health_timeout_s})
             except HealthCheckTimeout:
                 self._fence(rep, cause="health_check_timeout")
+            except TransportTimeout:
+                self._fence(rep, cause="transport_timeout")
+            except TransportCorruption:
+                self._fence(rep, cause="transport_corruption")
+
+    def _call_with_retry(self, dst: str, msg_class: str,
+                         payload: Dict[str, Any]) -> Dict[str, Any]:
+        """Transport call with the router's bounded retry budget:
+        ``fault_retries + 1`` immediate attempts absorbing in-flight
+        loss/corruption (each retry re-serializes, so a corrupted
+        message goes out clean; the receiver's idempotency makes a
+        delayed-but-processed message's retry harmless).  Exhaustion
+        raises ``RuntimeError`` — control-plane operations like
+        migration have no fallback tier, failing them loudly beats
+        silently dropping requests."""
+        last: Optional[Exception] = None
+        for _ in range(self.fault_retries + 1):
+            try:
+                return self.transport.call(dst, msg_class, payload)
+            except (TransportTimeout, TransportCorruption) as e:
+                last = e
+        raise RuntimeError(
+            f"{msg_class} to {dst} failed after "
+            f"{self.fault_retries + 1} attempts: {last}") from last
+
+    @staticmethod
+    def _migrate_handler(rep: ReplicaProxy,
+                         payload: Dict[str, Any]) -> Dict[str, Any]:
+        """Receiver side of a migration shipment: adopt the records
+        this replica does NOT already hold.  The rid-dedupe makes the
+        handler idempotent — a duplicated wire message, or a sender
+        retry after a delayed-but-processed delivery, finds the rids
+        live and adopts nothing twice."""
+        records = payload["records"]
+        fresh = [r for r in records
+                 if rep.find_request(int(r["rid"])) is None]
+        if fresh:
+            rep.adopt(fresh)
+        return {"ok": True,
+                "adopted": [int(r["rid"]) for r in records]}
 
     def _fence(self, rep: ReplicaProxy, cause: str,
                migrate: bool = True) -> None:
@@ -230,28 +334,49 @@ class FleetRouter:
         if migrate:
             self._migrate_requests(rep)
 
+    def _migration_targets(self, source: ReplicaProxy
+                           ) -> List[ReplicaProxy]:
+        """Candidate adopters for ``source``'s live requests: healthy
+        peers.  Overridable — the disaggregated router excludes
+        prefill-only replicas, whose engines would queue migrated
+        decode work forever."""
+        return [r for r in self.replicas
+                if r.healthy and r.name != source.name]
+
     def _migrate_requests(self, source: ReplicaProxy) -> List[Request]:
-        """Move every live request off ``source`` onto healthy peers.
-        The snapshot is JSON round-tripped (the serializability pin —
-        the in-process path must exercise exactly what an RPC boundary
-        will carry), the plan validates headroom + geometry before any
-        adopt, and each adopt validates atomically again — a failure
-        anywhere leaves every engine untouched and raises loudly.
-        Handles are REBOUND to the adopting engine's request objects;
-        token streams continue bitwise (deterministic re-prefill)."""
-        snap = json.loads(json.dumps(source.snapshot()))
-        records = snap["requests"]
+        """Move every live request off ``source`` onto healthy peers,
+        THROUGH the transport (serialize → deliver → deserialize is
+        now the serializability pin the old inline JSON round-trip
+        carried; in-flight loss/corruption costs bounded immediate
+        retries against the idempotent migrate handler).  The plan
+        validates headroom + geometry before any adopt, and each adopt
+        validates atomically again — a failure anywhere leaves every
+        engine untouched and raises loudly; a REFUSED plan additionally
+        emits ``migrate_refused`` with the full unplaceable list and
+        the required-vs-available page counts, so operators can size
+        capacity from the stream.  Handles are REBOUND to the adopting
+        engine's request objects; token streams continue bitwise
+        (deterministic re-prefill)."""
+        records = source.snapshot()["requests"]
         if not records:
             return []
-        targets = [r for r in self.replicas
-                   if r.healthy and r.name != source.name]
-        plan = plan_migration(records, targets)
+        targets = self._migration_targets(source)
+        try:
+            plan = plan_migration(records, targets)
+        except FleetCapacityError as e:
+            self._emit("migrate_refused", replica=source.name,
+                       unplaceable=list(e.unplaceable),
+                       requests=len(e.unplaceable),
+                       pages_required=e.pages_required,
+                       pages_available=e.pages_available)
+            raise
         moved: List[Request] = []
         for name, recs in sorted(plan.items()):
             if not recs:
                 continue
-            adopted = self._by_name[name].adopt(recs)
-            for req, rec in zip(adopted, recs):
+            self._call_with_retry(name, "migrate", {"records": recs})
+            for rec in recs:
+                req = self._by_name[name].find_request(int(rec["rid"]))
                 self.handles[req.rid] = req
                 self.placement[req.rid] = name
                 self._emit("request_migrate", rid=req.rid,
@@ -290,14 +415,20 @@ class FleetRouter:
         if self.on_round is not None:
             self.on_round()
 
+    def _fleet_busy(self) -> bool:
+        """Live work remains somewhere in the fleet (the
+        :meth:`run` drain predicate).  Overridable: the disaggregated
+        router also counts in-flight page transfers, which can be
+        backing off while every engine is momentarily idle."""
+        return any(r.healthy and not r.idle for r in self.replicas)
+
     def run(self, max_steps: int = 100_000) -> List[Request]:
         """Round until every in-rotation replica drains; returns the
         handles in rid order.  Non-drain raises — a backing-off
         replica still counts as live work, so the budget must cover
         backoff rounds too."""
         for _ in range(max_steps):
-            busy = [r for r in self.replicas if r.healthy and not r.idle]
-            if not busy:
+            if not self._fleet_busy():
                 break
             self.step()
             if self.scale_hint_every and \

@@ -38,6 +38,7 @@ state (and may thread them through ``jax.jit`` as loop carries).
 
 from __future__ import annotations
 
+import base64
 import bisect
 import functools
 import zlib
@@ -115,6 +116,30 @@ def _copy_page(pool, src, dst):
     return jax.lax.dynamic_update_slice_in_dim(pool, page, dst, axis=1)
 
 
+def _import_page(pool, page, dst):
+    """pool[:, dst] = page (a ``[layers, 1, ...]`` host slice) with a
+    traced destination, so importing a SHIPPED page (r18 disaggregation)
+    reuses one compiled executable regardless of the landing id."""
+    return jax.lax.dynamic_update_slice_in_dim(pool, page, dst, axis=1)
+
+
+def verify_page_payload(data: Dict[str, int]) -> bool:
+    """Host-side CRC check of one shipped-page payload (r18) — pure
+    base64/zlib, no device work, so receivers can reject a
+    corrupted-in-flight page BEFORE touching their pool.  The digest
+    recipe matches :meth:`PagedKVCache._page_digest` exactly (K bytes
+    plus — quantized, inferred from the scale keys — the K scale
+    bytes), so a payload that verifies here lands with a CRC the
+    importing pool's read-back validation will agree with."""
+    kb = base64.b64decode(data["k"])
+    vb = base64.b64decode(data["v"])
+    if "k_scale" in data:
+        kb += base64.b64decode(data["k_scale"])
+        vb += base64.b64decode(data["v_scale"])
+    return (zlib.crc32(kb) == data["crc_k"]
+            and zlib.crc32(vb) == data["crc_v"])
+
+
 class PagePoolExhausted(RuntimeError):
     """No free pages left — the scheduler's cue to preempt, never an
     OOM: the pool size is fixed at construction and allocation failure
@@ -183,6 +208,9 @@ class PagedKVCache:
             self._scatter = jax.jit(_scatter_tokens, donate_argnums=donate)
         self._copy = jax.jit(
             _copy_page,
+            donate_argnums=(0,) if jax.default_backend() == "tpu" else ())
+        self._import = jax.jit(
+            _import_page,
             donate_argnums=(0,) if jax.default_backend() == "tpu" else ())
         # sorted free list, lowest-first allocation: deterministic
         self._free: List[int] = list(range(1, num_pages))
@@ -384,6 +412,95 @@ class PagedKVCache:
         if self.quantize:
             self.k_scale = self._copy(self.k_scale, z, z)
             self.v_scale = self._copy(self.v_scale, z, z)
+
+    def warm_import(self) -> None:
+        """Compile the shipped-page import executable
+        (:meth:`import_page_bytes`'s ``_import_page``) against the
+        live pool shapes — an all-zero page written into scratch page
+        0, a content no-op no reader ever sees — so a decode replica's
+        FIRST inbound shipment never pays a jit compile.  Quantized
+        pools warm the scale-plane shape too (same function, second
+        specialization).  Called from ``ServingEngine.warmup`` when
+        ``kv_import`` is on; part of the zero-compiles-after-warmup
+        contract."""
+        z = jnp.int32(0)
+        pshape = (self.num_layers, 1, self.page_size,
+                  self.num_heads, self.head_dim)
+        self.k = self._import(self.k, jnp.zeros(pshape, self.k.dtype), z)
+        self.v = self._import(self.v, jnp.zeros(pshape, self.v.dtype), z)
+        if self.quantize:
+            sshape = (self.num_layers, 1, self.page_size, self.num_heads)
+            zs = jnp.zeros(sshape, jnp.float32)
+            self.k_scale = self._import(self.k_scale, zs, z)
+            self.v_scale = self._import(self.v_scale, zs, z)
+
+    def warm_export(self) -> None:
+        """Compile the page-slice gather :meth:`export_page_bytes`
+        reads the pool through (``k[:, page:page+1]`` is a device op)
+        by exporting scratch page 0 once and discarding the payload —
+        so a prefill replica's FIRST outbound shipment never pays a
+        jit compile.  Called from ``ServingEngine.warmup`` when
+        ``prefill_only`` is on; the export twin of
+        :meth:`warm_import`."""
+        self.export_page_bytes(0)
+
+    # -- page shipping (r18 disaggregation) ------------------------------
+
+    def export_page_bytes(self, page: int) -> Dict[str, int]:
+        """Serialize one page for shipping: C-order K/V page slices
+        (quantized: the narrow codes, plus the fp32 scale planes as
+        separate keys) as base64 text, with per-page CRCs stamped at
+        export using the :meth:`_page_digest` recipe — the receiver
+        verifies them host-side (:func:`verify_page_payload`) before
+        its pool ever sees the bytes, and records them as the imported
+        page's read-back digest."""
+        k = np.ascontiguousarray(np.asarray(self.k[:, page:page + 1]))
+        v = np.ascontiguousarray(np.asarray(self.v[:, page:page + 1]))
+        kb, vb = k.tobytes(), v.tobytes()
+        out = {"k": base64.b64encode(kb).decode("ascii"),
+               "v": base64.b64encode(vb).decode("ascii")}
+        if self.quantize:
+            ksb = np.ascontiguousarray(
+                np.asarray(self.k_scale[:, page:page + 1])).tobytes()
+            vsb = np.ascontiguousarray(
+                np.asarray(self.v_scale[:, page:page + 1])).tobytes()
+            out["k_scale"] = base64.b64encode(ksb).decode("ascii")
+            out["v_scale"] = base64.b64encode(vsb).decode("ascii")
+            kb += ksb
+            vb += vsb
+        out["crc_k"] = zlib.crc32(kb)
+        out["crc_v"] = zlib.crc32(vb)
+        return out
+
+    def import_page_bytes(self, page: int, data: Dict[str, int]) -> None:
+        """Land one shipped payload in (already allocated) ``page``,
+        verbatim: the pool bytes after import are bitwise the source
+        pool's bytes — including quantized codes and scale planes — so
+        decode over an imported page is indistinguishable from decode
+        over a locally prefilled one.  Callers verify the payload
+        first (:func:`verify_page_payload`); this method trusts it and
+        records the shipped CRCs as the page's read-back digest."""
+        pshape = (self.num_layers, 1, self.page_size,
+                  self.num_heads, self.head_dim)
+        dst = jnp.int32(page)
+        k = np.frombuffer(base64.b64decode(data["k"]),
+                          dtype=np.dtype(self.k.dtype)).reshape(pshape)
+        v = np.frombuffer(base64.b64decode(data["v"]),
+                          dtype=np.dtype(self.v.dtype)).reshape(pshape)
+        self.k = self._import(self.k, jnp.asarray(k), dst)
+        self.v = self._import(self.v, jnp.asarray(v), dst)
+        if self.quantize:
+            sshape = (self.num_layers, 1, self.page_size, self.num_heads)
+            ks = np.frombuffer(base64.b64decode(data["k_scale"]),
+                               dtype=np.float32).reshape(sshape)
+            vs = np.frombuffer(base64.b64decode(data["v_scale"]),
+                               dtype=np.float32).reshape(sshape)
+            self.k_scale = self._import(self.k_scale, jnp.asarray(ks), dst)
+            self.v_scale = self._import(self.v_scale, jnp.asarray(vs), dst)
+        if self.crc_pages:
+            # shipped bytes land verbatim, so the export digest IS the
+            # imported page's digest — no device read-back needed
+            self._crc[page] = (data["crc_k"], data["crc_v"])
 
     def analysis_executable(self, n_tokens: int, *, donate: bool = True):
         """``jax.stages.Lowered`` of the :meth:`write_tokens` scatter

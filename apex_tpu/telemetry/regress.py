@@ -61,6 +61,16 @@ GATED_LOWER = (
     # the int8 pool).  Genuinely new coverage: no suffix rule above
     # matches it.  Direction pinned by test_pool_peak_direction_rule.
     r"_pool_peak$",
+    # r18: disaggregation fallback rate (fleet_ship_fallback_rate /
+    # serving_ship_fallback_rate) — the share of KV shipments that
+    # exhausted their retry budget and degraded to local prefill.
+    # Genuinely new coverage: no suffix rule above matches it (note
+    # `_hit_rate$` is HIGHER — a fallback is a miss, not a hit).
+    # Direction pinned by test_ship_fallback_rate_direction_rule; the
+    # companion retry rate stays deliberately UNGATED (reported only):
+    # the right retry count depends on the injected fault rate, so
+    # the gate must not guess a direction for it.
+    r"_ship_fallback_rate$",
 )
 
 #: Higher-is-better key patterns: throughput, efficiency, rooflines,

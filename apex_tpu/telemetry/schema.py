@@ -295,6 +295,54 @@ EVENT_FIELDS: Dict[str, Dict[str, FieldSpec]] = {
         # optional means absent, never a sentinel
         "deadline_hit_rate": opt(*NUMBER),
     },
+    # disaggregated prefill/decode (r18): one kv_ship per completed
+    # KV page shipment (prefill replica -> decode replica; attempts
+    # counts transfer-level retries that preceded success),
+    # kv_ship_retry per bounded retry (reason is CLOSED: transport
+    # loss/lateness, in-flight corruption caught at the envelope, a
+    # page refused by the receiver's CRC check, pages missing at
+    # commit, or a capacity refusal by the decode engine), and
+    # kv_ship_fallback when the retry budget is spent and the request
+    # degrades to LOCAL prefill on the decode replica — slower, never
+    # dropped
+    "kv_ship": {
+        "rid": req(int),
+        "from_replica": req(str),
+        "to_replica": req(str),
+        "pages": req(int),
+        "payload_bytes": req(int),
+        "attempts": req(int),
+    },
+    "kv_ship_retry": {
+        "rid": req(int),
+        "from_replica": req(str),
+        "to_replica": req(str),
+        "attempt": req(int),
+        "reason": req(str, choices=("timeout", "corrupt",
+                                    "crc_mismatch", "missing_pages",
+                                    "no_capacity")),
+        # absent on immediate per-page re-sends (no backoff round)
+        "backoff_rounds": opt(int),
+    },
+    "kv_ship_fallback": {
+        "rid": req(int),
+        "from_replica": req(str),
+        "to_replica": req(str),
+        "attempts": req(int),
+        "reason": req(str, choices=("timeout", "corrupt",
+                                    "crc_mismatch", "missing_pages",
+                                    "no_capacity")),
+    },
+    # a migration plan refused whole (r18 satellite): the FULL
+    # unplaceable rid list plus required-vs-available page counts —
+    # the numbers an operator sizes capacity from
+    "migrate_refused": {
+        "replica": req(str),
+        "unplaceable": req(list),
+        "requests": req(int),
+        "pages_required": req(int),
+        "pages_available": req(int),
+    },
     # in-run attribution (ISSUE 9): the ProfileSampler's window result.
     # exposed_collective_ms is the overlap-analysis headline;
     # overhead_ms is the sampler's own host cost for this window (also

@@ -174,6 +174,18 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             round(prefix_hits / prefix_total, 4)
             if prefix_total else None)
         out["serving_shared_pages_peak"] = shared_pages_peak
+        # disaggregated prefill/decode (r18): shipment health over
+        # every transfer OUTCOME (success or fallback — retries are a
+        # cost, not an outcome, so they scale neither rate); None when
+        # the stream carried no ship traffic at all
+        ships = counts.get("kv_ship", 0)
+        fallbacks = counts.get("kv_ship_fallback", 0)
+        out["serving_ship_success_rate"] = (
+            round(ships / (ships + fallbacks), 4)
+            if ships + fallbacks else None)
+        out["serving_ship_fallback_rate"] = (
+            round(fallbacks / (ships + fallbacks), 4)
+            if ships + fallbacks else None)
     if counts.get("profile"):
         # phase attribution (ISSUE 9): mean per-phase device ms over the
         # run's sampled windows — the answer to "where do a step's
@@ -267,6 +279,10 @@ def format_summary(s: Dict[str, Any]) -> str:
         if s.get("serving_shared_pages_peak"):
             parts.append(
                 f"shared pages peak {s['serving_shared_pages_peak']}")
+        if s.get("serving_ship_success_rate") is not None:
+            parts.append(
+                f"ship ok {_pct(s['serving_ship_success_rate'])} "
+                f"fallback {_pct(s.get('serving_ship_fallback_rate'))}")
         lines.append("  ".join(parts))
     if s.get("profile_samples"):
         parts = ["phases      " + "  ".join(
@@ -315,6 +331,9 @@ _DIFF_ROWS = (
     # quantized pool move the occupancy high-water mark?
     ("serving_prefix_hit_rate", "prefix hit", "{:.3f}"),
     ("serving_pool_peak", "pool peak", "{:.3f}"),
+    # disaggregation health (r18): did the change push shipments past
+    # their retry budget into local-prefill fallbacks?
+    ("serving_ship_fallback_rate", "ship fallback", "{:.3f}"),
     # phase-attribution rows (ISSUE 9): did the change move exposed
     # communication or the memory high-water mark?
     ("exposed_collective_ms", "exposed (ms)", "{:.2f}"),

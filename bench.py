@@ -2128,10 +2128,18 @@ def bench_fleet():
     from apex_tpu.serving import (ServingEngine, ServingModelConfig,
                                   init_params)
     from apex_tpu.telemetry.summarize import percentile
-    from apex_tpu.serving.fleet import (FleetRouter, ReplicaProxy, SLOClass,
+    from apex_tpu.serving.fleet import (DisaggRouter, FleetRouter,
+                                        ReplicaProxy, SLOClass,
                                         rolling_restart)
 
     n_rep = int(os.environ.get("BENCH_FLEET_REPLICAS", "3"))
+    # r18 A/B axis: BENCH_FLEET_DISAGG=1 splits the same replica count
+    # into a prefill tier and a decode tier behind a DisaggRouter —
+    # every finished prefill's KV pages ship over the transport seam
+    # instead of decoding in place.  The committed r18 pair is
+    # colocated-4 vs 2p+2d at otherwise identical config.
+    disagg = os.environ.get("BENCH_FLEET_DISAGG", "0") not in ("", "0")
+    n_prefill = n_rep // 2 if disagg else 0
     L = int(os.environ.get("BENCH_FLEET_LAYERS", "4"))
     H = int(os.environ.get("BENCH_FLEET_HIDDEN", "256"))
     NH = int(os.environ.get("BENCH_FLEET_HEADS", "8"))
@@ -2179,23 +2187,34 @@ def bench_fleet():
 
     clk = _VClock(0.01)  # 10 virtual ms per fleet round
 
-    def factory():
-        return ServingEngine(cfg, params, num_pages=num_pages,
-                             page_size=page_size, max_batch=max_batch,
-                             max_pages_per_request=pages_per_req,
-                             prefill_budget=max_pos, telemetry=bus,
-                             clock=clk,
-                             # bounded, but wide enough for the
-                             # all-upfront segment load on ONE replica
-                             # (the A side of the committed pair):
-                             # zero drops is a record invariant
-                             max_queue=2 * n_req,
-                             reject_unservable=True)
+    def factory(**role_kw):
+        def build():
+            return ServingEngine(cfg, params, num_pages=num_pages,
+                                 page_size=page_size, max_batch=max_batch,
+                                 max_pages_per_request=pages_per_req,
+                                 prefill_budget=max_pos, telemetry=bus,
+                                 clock=clk,
+                                 # bounded, but wide enough for the
+                                 # all-upfront segment load on ONE replica
+                                 # (the A side of the committed pair):
+                                 # zero drops is a record invariant
+                                 max_queue=2 * n_req,
+                                 reject_unservable=True, **role_kw)
+        return build
 
-    fleet = FleetRouter(
-        [ReplicaProxy(f"r{i}", factory) for i in range(n_rep)],
-        telemetry=bus, on_round=clk.tick,
-        slo_classes=[SLOClass("standard"), SLOClass("best_effort")])
+    slo_classes = [SLOClass("standard"), SLOClass("best_effort")]
+    if disagg:
+        reps = [ReplicaProxy(f"p{i}", factory(prefill_only=True),
+                             role="prefill") for i in range(n_prefill)]
+        reps += [ReplicaProxy(f"d{i}", factory(kv_import=True),
+                              role="decode")
+                 for i in range(n_rep - n_prefill)]
+        fleet = DisaggRouter(reps, telemetry=bus, on_round=clk.tick,
+                             slo_classes=slo_classes)
+    else:
+        fleet = FleetRouter(
+            [ReplicaProxy(f"r{i}", factory()) for i in range(n_rep)],
+            telemetry=bus, on_round=clk.tick, slo_classes=slo_classes)
     compile_s = fleet.warmup()
 
     rng = _random.Random(0)
@@ -2246,6 +2265,12 @@ def bench_fleet():
     n_events = tel.validate_jsonl(stream)  # the acceptance contract
     moves = sum(1 for e in mem.events if e["type"] == "request_migrate")
     fences = sum(1 for e in mem.events if e["type"] == "replica_fence")
+    ships = sum(1 for e in mem.events if e["type"] == "kv_ship")
+    ship_retries = sum(1 for e in mem.events
+                       if e["type"] == "kv_ship_retry")
+    ship_falls = sum(1 for e in mem.events
+                     if e["type"] == "kv_ship_fallback")
+    ship_outcomes = ships + ship_falls
     dropped = [r for r in steady + restart
                if fleet.handles[r].finish_reason
                not in ("eos", "length")]
@@ -2262,10 +2287,20 @@ def bench_fleet():
         "fleet_recompiles_after_warmup": g.recompiles,
         "fleet_migrations": moves,
         "fleet_fences": fences,
+        # KV-shipment outcomes (always present so the gate's --keys
+        # list holds on both sides of the A/B; colocated reads all-0):
+        # fallback rate is GATED_LOWER, retry rate reported-not-gated
+        "fleet_kv_ships": ships,
+        "fleet_ship_fallback_rate":
+        round(ship_falls / ship_outcomes, 4) if ship_outcomes else 0.0,
+        "fleet_ship_retry_rate":
+        round(ship_retries / ship_outcomes, 4) if ship_outcomes else 0.0,
         "fleet_compile_s": round(compile_s, 2),
         "fleet_stream_events": n_events,
         "fleet_telemetry_file": os.path.basename(stream),
         "fleet_config": {
+            "mode": ("disagg" if disagg else "colocated"),
+            "prefill_replicas": n_prefill,
             "replicas": n_rep, "layers": L, "hidden": H, "heads": NH,
             "vocab": V, "page_size": page_size, "num_pages": num_pages,
             "max_batch": max_batch, "n_requests_per_segment": n_req,

@@ -693,6 +693,56 @@ def test_regress_serving_keys_mandatory_on_committed_r17_pair(capsys):
                                "gone_key"]) == 1
 
 
+def test_regress_disagg_keys_mandatory_on_committed_r18_pair(capsys):
+    """r18 satellite: the disagg headline keys are MANDATORY over the
+    committed r18 pair (A = 4 colocated replicas, B = the same four
+    split 2 prefill + 2 decode behind the transport seam; same offered
+    load, single decode wave per segment so the comparison gates the
+    SHIPPING overhead rather than halved decode slots, both cpu-toy
+    self-stamped).  The gate proves the acceptance criteria on
+    committed data: every request's KV pages shipped (no local-prefill
+    fallback, ``fleet_ship_fallback_rate`` gated lower-is-better at
+    0.0), aggregate decode throughput holds within the regress budget,
+    and both arrangements drop nothing and never recompile after
+    warmup — including through the rolling restart both records
+    carry."""
+    a = os.path.join(REPO, "BENCH_r18_fleet.json")
+    b = os.path.join(REPO, "BENCH_r18b_fleet.json")
+    rc = tele_cli(["regress", a, b, "--max-regress", "25", "--json",
+                   "--keys", "fleet_decode_tokens_per_sec,"
+                             "fleet_ship_fallback_rate,"
+                             "fleet_kv_ships,"
+                             "fleet_dropped"])
+    rec = json.loads(capsys.readouterr().out)
+    assert rc == 0, rec["failures"]
+    by_key = {r["key"]: r for r in rec["rows"]}
+    assert by_key["fleet_decode_tokens_per_sec"]["direction"] == "higher"
+    fall = by_key["fleet_ship_fallback_rate"]
+    assert fall["direction"] == "lower"
+    assert fall["a"] == 0.0 and fall["b"] == 0.0
+    # a shipment counter has no "better" direction — reported, not gated
+    assert by_key["fleet_kv_ships"]["gated"] is False
+    ka, kb = (json.load(open(p)) for p in (a, b))
+    # the A side is the colocated control: nothing ships, the keys
+    # still exist (the --keys list must hold on BOTH sides)
+    assert ka["fleet_config"]["mode"] == "colocated"
+    assert ka["fleet_kv_ships"] == 0
+    # the B side shipped EVERY request exactly once — zero fallbacks
+    # AND zero double-ships (idempotency in record form)
+    assert kb["fleet_config"]["mode"] == "disagg"
+    assert kb["fleet_config"]["prefill_replicas"] == 2
+    assert kb["fleet_kv_ships"] == kb["fleet_requests"]
+    assert kb["fleet_ship_fallback_rate"] == 0.0
+    for rec_ in (ka, kb):
+        assert rec_["fleet_dropped"] == 0
+        assert rec_["fleet_recompiles_after_warmup"] == 0
+        assert rec_["fleet_config"]["geometry"] == "cpu-toy"
+    # ...and a vanished mandatory key is a failure, not a skip
+    assert tele_cli(["regress", a, b, "--max-regress", "25",
+                     "--keys", "fleet_ship_fallback_rate,"
+                               "gone_key"]) == 1
+
+
 def test_multichip_records_are_geometry_stamped(tmp_path):
     """ISSUE 15 satellite (the ROADMAP maintenance note's last gap):
     every committed MULTICHIP_r*.json self-declares its geometry, and
