@@ -72,7 +72,10 @@ HOT_PATH_FUNCTIONS: Dict[str, Set[str]] = {
         # ISSUE 12: the speculative verify step, the chunked-prefill
         # step, and the draft-proposal loop run at every decode
         # boundary — same steady-state heat as _decode_batch
-        "_verify_batch", "_chunk_step", "_propose_drafts"},
+        "_verify_batch", "_chunk_step", "_propose_drafts",
+        # r19: span emission rides retirement and the decode loop —
+        # tracing must stay pure host bookkeeping, never a device pull
+        "_retire", "_emit_retire_spans"},
     "apex_tpu/serving/kv_cache.py": {"_page_digest"},
     # ISSUE 12: proposer lookup (per decode boundary per request) and
     # the chunk splitter (per boundary)
@@ -88,7 +91,12 @@ HOT_PATH_FUNCTIONS: Dict[str, Set[str]] = {
     # every fleet round — pure host json/zlib/base64 work; a device
     # pull here would stall the whole fleet per message
     "apex_tpu/serving/fleet/transport.py": {"call", "deliver"},
-    "apex_tpu/serving/fleet/disagg.py": {"_pump_disagg", "_drive"},
+    # r19: the ship/import span emitters and the page handlers run per
+    # wire message inside the pump — tracing overhead must stay host-
+    # side (and sync-free) at the same heat as the pump itself
+    "apex_tpu/serving/fleet/disagg.py": {
+        "_pump_disagg", "_drive", "_emit_ship_span",
+        "on_page", "on_commit"},
     "apex_tpu/transformer/testing/train_loop.py": {
         "run_resilient_training"},
     "apex_tpu/resilience/elastic.py": {"run_elastic_training"},

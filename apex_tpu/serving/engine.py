@@ -763,6 +763,7 @@ class ServingEngine:
                 f"reserved pages, context needs {need} — pages must be "
                 "reserved at admission")
         _fault_point("prefill", req.rid)
+        prefill_t0 = self.clock()
         tokens = np.zeros((1, S), np.int32)
         tokens[0, :C] = ctx
         seg = np.zeros((1, S), np.int32)
@@ -788,6 +789,18 @@ class ServingEngine:
         req.generated.append(int(next_tok))
         if req.first_token_t is None:
             req.first_token_t = self.clock()
+            # colocated path: the token is streamable the instant it
+            # is sampled (a shipped request's stream_t is stamped at
+            # adoption instead — r19 shipping-aware TTFT)
+            req.stream_t = req.first_token_t
+        # single-shot prefill = one prefill_chunk span covering the
+        # whole context (the chunked path emits one per chunk)
+        life = self._life(req)
+        self._emit("span", rid=req.rid,
+                   span_id=f"{req.rid}:prefill_chunk:{life}:0",
+                   parent_id=f"{req.rid}:admit:{life}",
+                   kind="prefill_chunk", t_start=prefill_t0,
+                   t_end=self.clock())
 
     def _register_prefix(self, ctx: Sequence[int],
                          pages: List[int]) -> None:
@@ -941,6 +954,7 @@ class ServingEngine:
         pull anything to the host, so a long prefill stays one async
         dispatch per boundary."""
         _fault_point("prefill", req.rid)
+        t0 = self.clock()
         # opt-in CRC read-back, like every other pool-reading step:
         # this chunk attends over the pages earlier chunks filled — a
         # corrupted earlier page must raise HERE, before the final
@@ -989,12 +1003,27 @@ class ServingEngine:
             req.generated.append(int(np.asarray(next_tok)[0]))
             if req.first_token_t is None:
                 req.first_token_t = self.clock()
+                req.stream_t = req.first_token_t
+        life = self._life(req)
+        self._emit("span", rid=req.rid,
+                   span_id=f"{req.rid}:prefill_chunk:{life}:{start}",
+                   parent_id=f"{req.rid}:admit:{life}",
+                   kind="prefill_chunk", t_start=t0,
+                   t_end=self.clock())
 
     # -- the engine step ---------------------------------------------------
 
     def _emit(self, type_: str, **payload) -> None:
         if self.telemetry is not None:
             self.telemetry.emit(type_, step=self.steps, **payload)
+
+    @staticmethod
+    def _life(req: Request) -> str:
+        """The r19 admission-life discriminator shared by every span
+        of one (re)admission — ``preemptions`` alone is not unique
+        across a fallback re-admission, ``admit_t`` on the shared
+        clock makes it so (docs/tracing.md, "Span identity")."""
+        return f"{req.preemptions}:{req.admit_t:.6f}"
 
     def _retire(self, now: float) -> List[Request]:
         done = self.sched.retire_finished(now)
@@ -1004,19 +1033,58 @@ class ServingEngine:
             n = len(req.generated)
             ev = dict(rid=req.rid, reason=req.finish_reason,
                       new_tokens=n, preemptions=req.preemptions)
+            # r19 shipping-aware TTFT (the PR 18 open item): measure
+            # to stream_t — when the first token became STREAMABLE —
+            # so a disaggregated request's kv_ship wall lands in TTFT
+            # (where the SLO feels it), not hidden inside TPOT.
+            # Colocated paths have stream_t == first_token_t; a
+            # migrated re-prefill keeps the original first-token time
+            # (the client already held those tokens).
+            stream_t = (req.stream_t if req.stream_t is not None
+                        else req.first_token_t)
             if req.first_token_t is not None:
                 ev["ttft_ms"] = round(
-                    (req.first_token_t - req.arrival_t) * 1e3, 3)
+                    (stream_t - req.arrival_t) * 1e3, 3)
+                if req.ship_s > 0.0:
+                    ev["ship_ms"] = round(req.ship_s * 1e3, 3)
                 if n > 1 and req.finish_t is not None:
                     ev["tpot_ms"] = round(
-                        (req.finish_t - req.first_token_t) / (n - 1) * 1e3,
+                        (req.finish_t - stream_t) / (n - 1) * 1e3,
                         3)
             if req.deadline_t is not None and req.finish_t is not None:
                 # a real bool, present only when a deadline existed —
                 # optionality explicit, never a sentinel
                 ev["deadline_hit"] = bool(req.finish_t <= req.deadline_t)
             self._emit("request_retire", **ev)
+            self._emit_retire_spans(req, stream_t, now)
         return done
+
+    def _emit_retire_spans(self, req: Request, stream_t, now: float
+                           ) -> None:
+        """The decode-side tail of the request's trace (r19), emitted
+        once at retirement — spans buffer host-side state only, no
+        device fetches, so the decode loop stays host-sync-free:
+        ``decode_wait`` (prefill done -> streamable: the export-pump
+        wait plus the kv_ship wall on a disaggregated path, ~0
+        colocated), ``decode_steps`` (stream -> finish), and the
+        ``stream_emit`` point span the TTFT decomposition ends at."""
+        if self.telemetry is None or stream_t is None \
+                or req.admit_t is None:
+            return
+        life = self._life(req)
+        dw = f"{req.rid}:decode_wait:{life}"
+        self._emit("span", rid=req.rid, span_id=dw,
+                   parent_id=f"{req.rid}:admit:{life}",
+                   kind="decode_wait", t_start=req.first_token_t,
+                   t_end=stream_t)
+        self._emit("span", rid=req.rid,
+                   span_id=f"{req.rid}:decode_steps:{life}",
+                   parent_id=dw, kind="decode_steps",
+                   t_start=stream_t, t_end=now)
+        self._emit("span", rid=req.rid,
+                   span_id=f"{req.rid}:stream_emit:{life}",
+                   parent_id=dw, kind="stream_emit",
+                   t_start=stream_t, t_end=stream_t)
 
     def _expire(self, now: float) -> bool:
         """Deadline enforcement for this step boundary: shed queued
@@ -1095,6 +1163,20 @@ class ServingEngine:
                 # a miss indistinguishable from a sharing-off engine
                 ev["prefix_hit"] = bool(req.prefix_hit)
             self._emit("request_admit", **ev)
+            # r19 trace: every (re)admission opens a new life —
+            # queue_wait is root-level (arrival -> admission), admit
+            # covers the admission itself plus a whole-row prefill
+            # (a chunked admission's prefill wall rides its
+            # prefill_chunk child spans instead)
+            life = self._life(req)
+            qid = f"{req.rid}:queue_wait:{life}"
+            self._emit("span", rid=req.rid, span_id=qid,
+                       kind="queue_wait", t_start=req.arrival_t,
+                       t_end=now)
+            self._emit("span", rid=req.rid,
+                       span_id=f"{req.rid}:admit:{life}",
+                       parent_id=qid, kind="admit", t_start=now,
+                       t_end=self.clock())
             progress = True
         for req, start, n in chunk_plan:
             self._chunk_step(req, start, n)
@@ -1333,8 +1415,19 @@ class ServingEngine:
         if req.prefill_pos is not None or not req.generated:
             raise ValueError(
                 f"export_request: rid {rid} has not finished prefill")
+        t0 = self.clock()
         pages_payload = [self.cache.export_page_bytes(p)
                          for p in req.pages]
+        # r19 trace: the export span opens the ship segment of the
+        # TTFT decomposition (kv_export.start -> kv_import.end);
+        # export_t/export_span ride the record so the decode side can
+        # account the ship wall and parent its spans without parsing
+        # ids (adopt ignores unknown record keys by construction)
+        life = self._life(req)
+        export_span = f"{req.rid}:kv_export:{life}"
+        self._emit("span", rid=req.rid, span_id=export_span,
+                   parent_id=f"{req.rid}:admit:{life}",
+                   kind="kv_export", t_start=t0, t_end=self.clock())
         record = {
             "rid": req.rid,
             "prompt": list(req.prompt),
@@ -1347,6 +1440,8 @@ class ServingEngine:
             "admit_t": req.admit_t,
             "first_token_t": req.first_token_t,
             "was_running": True,
+            "export_t": t0,
+            "export_span": export_span,
         }
         kv_len = req.kv_len
         self.sched.running.remove(req)
@@ -1420,6 +1515,17 @@ class ServingEngine:
         req.state = RUNNING
         self.sched.running.append(req)
         self._next_rid = max(self._next_rid, req.rid + 1)
+        # r19 shipping-aware SLO accounting: the first token was
+        # sampled at export but is only STREAMABLE now that its KV
+        # landed here — stamp adoption as stream_t and book the
+        # export->adopt wall as the request's kv_ship cost (== its
+        # kv_export.start -> kv_import.end span segment); _retire
+        # moves that wall into TTFT instead of hiding it in TPOT
+        now = self.clock()
+        req.stream_t = now
+        export_t = record.get("export_t")
+        if export_t is not None:
+            req.ship_s = max(0.0, now - float(export_t))
         self._emit("request_admit", rid=req.rid,
                    context_tokens=kv_len, pages=len(pages),
                    preemptions=req.preemptions)
@@ -1521,8 +1627,18 @@ class ServingEngine:
 
     def _handle_fault(self, exc: BaseException) -> None:
         """Absorb a recoverable mid-decode fault via :meth:`recover`,
-        or re-raise when recovery is disabled/exhausted."""
+        or re-raise when recovery is disabled/exhausted — exhaustion
+        first dumps the flight-recorder ring as a trace bundle (r19):
+        the chaos outcome ships its own post-mortem."""
         if not self.recover_on_fault or self.recoveries >= self.max_recoveries:
+            if self.recover_on_fault and self.telemetry is not None:
+                from apex_tpu.telemetry.tracing import \
+                    maybe_dump_flight_record
+
+                maybe_dump_flight_record(
+                    self.telemetry,
+                    f"recovery_exhausted:{type(exc).__name__}",
+                    step=self.steps)
             raise exc
         device_ids = getattr(exc, "device_ids", None)
         if device_ids is not None:

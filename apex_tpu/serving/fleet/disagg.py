@@ -65,10 +65,14 @@ class PageImporter:
     at-least-once wire cannot admit a request twice (a retried commit
     after a delayed-but-processed one returns the memoized success)."""
 
-    def __init__(self, rep: ReplicaProxy):
+    def __init__(self, rep: ReplicaProxy, transport=None):
         self.rep = rep
+        self.transport = transport
         self._buf: Dict[str, Dict[int, Dict[str, Any]]] = {}
         self._done: Dict[str, Dict[str, Any]] = {}
+        #: transfer_id -> engine-clock time the FIRST page arrived —
+        #: the kv_import span opens at first byte, not at commit
+        self._t0: Dict[str, float] = {}
 
     def on_page(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         tid = payload["transfer_id"]
@@ -76,6 +80,7 @@ class PageImporter:
             # a page re-sent after its transfer already committed
             # (delayed reply → sender retry): the transfer is over
             return {"ok": True}
+        self._t0.setdefault(tid, self.rep.engine.clock())
         buf = self._buf.setdefault(tid, {})
         idx = int(payload["page_index"])
         if idx in buf:
@@ -101,6 +106,7 @@ class PageImporter:
             reply = {"ok": True, "rid": rid}
             self._done[tid] = reply
             self._buf.pop(tid, None)
+            self._t0.pop(tid, None)
             return reply
         n_pages = int(payload["n_pages"])
         buf = self._buf.get(tid, {})
@@ -123,7 +129,24 @@ class PageImporter:
         reply = {"ok": True, "rid": int(payload["record"]["rid"])}
         self._done[tid] = reply
         del self._buf[tid]
+        self._emit_import_span(tid, rid)
         return reply
+
+    def _emit_import_span(self, tid: str, rid: int) -> None:
+        """The receiver half of the ship pair: ``kv_import`` spans
+        first-page-arrival → adopted, parented on the LITERAL sender
+        ``kv_ship`` span id carried in the wire trace context — the
+        causal join survives retries/duplicates because the context
+        rides the envelope verbatim."""
+        ctx = (self.transport.current_trace
+               if self.transport is not None else None) or {}
+        now = self.rep.engine.clock()
+        self.rep.engine._emit(
+            "span", rid=rid,
+            span_id=f"{rid}:kv_import:{int(ctx.get('attempt', 0))}",
+            parent_id=ctx.get("span_id"), kind="kv_import",
+            t_start=self._t0.pop(tid, now), t_end=now,
+            replica=self.rep.name, attempt=int(ctx.get("attempt", 0)))
 
 
 class _Transfer:
@@ -142,6 +165,11 @@ class _Transfer:
         self.acked: set = set()
         self.attempts = 0
         self.backoff_until = 0
+        # tracing state for the CURRENT drive attempt (one kv_ship
+        # span per attempt; ids carry the destination + attempt no)
+        self.span_t0: Optional[float] = None
+        self.span_id: Optional[str] = None
+        self.span_attempt = 0
 
 
 class DisaggRouter(FleetRouter):
@@ -175,7 +203,7 @@ class DisaggRouter(FleetRouter):
         self._importers: Dict[str, PageImporter] = {}
         for rep in self.replicas:
             if rep.role in ("decode", "mixed"):
-                imp = PageImporter(rep)
+                imp = PageImporter(rep, transport=self.transport)
                 self._importers[rep.name] = imp
                 self.transport.register(rep.name, "kv_page", imp.on_page)
                 self.transport.register(rep.name, "kv_commit",
@@ -264,6 +292,14 @@ class DisaggRouter(FleetRouter):
                     raise RuntimeError(
                         "no healthy decode-capable replica to "
                         f"retarget rid {t.rid}'s transfer to")
+                now = self._clock()
+                self._by_name[t.src].engine._emit(
+                    "span", rid=t.rid,
+                    span_id=(f"{t.rid}:kv_ship:{t.dst}"
+                             f":retarget:{self.round}"),
+                    parent_id=t.record.get("export_span"),
+                    kind="kv_ship", t_start=now, t_end=now,
+                    replica=t.src, outcome="retarget")
                 t.dst = dst.name
                 t.acked = set()
             if t.backoff_until > self.round:
@@ -278,6 +314,14 @@ class DisaggRouter(FleetRouter):
         by the same attempt budget); past the budget the transfer
         falls back to local prefill on the decode replica."""
         n = len(t.pages)
+        t.span_attempt = t.attempts + 1
+        t.span_t0 = self._clock()
+        t.span_id = f"{t.rid}:kv_ship:{t.dst}:{t.span_attempt}"
+        # the trace context every wire message of this attempt carries
+        # (envelope-level, outside the payload CRC): the receiver
+        # parents its kv_import span on the literal span id
+        ctx = {"rid": t.rid, "span_id": t.span_id,
+               "attempt": t.span_attempt}
         try:
             for i in range(n):
                 if i in t.acked:
@@ -285,7 +329,7 @@ class DisaggRouter(FleetRouter):
                 reply = self.transport.call(
                     t.dst, "kv_page",
                     {"transfer_id": t.transfer_id, "page_index": i,
-                     "n_pages": n, "data": t.pages[i]})
+                     "n_pages": n, "data": t.pages[i]}, trace=ctx)
                 retries = 0
                 while not reply.get("ok"):
                     # corrupted in flight: the receiver refused the
@@ -298,12 +342,12 @@ class DisaggRouter(FleetRouter):
                     reply = self.transport.call(
                         t.dst, "kv_page",
                         {"transfer_id": t.transfer_id, "page_index": i,
-                         "n_pages": n, "data": t.pages[i]})
+                         "n_pages": n, "data": t.pages[i]}, trace=ctx)
                 t.acked.add(i)
             reply = self.transport.call(
                 t.dst, "kv_commit",
                 {"transfer_id": t.transfer_id, "record": t.record,
-                 "kv_len": t.kv_len, "n_pages": n})
+                 "kv_len": t.kv_len, "n_pages": n}, trace=ctx)
         except TransportTimeout:
             self._bump(t, reason="timeout")
             return
@@ -311,6 +355,7 @@ class DisaggRouter(FleetRouter):
             self._bump(t, reason="corrupt")
             return
         if reply.get("ok"):
+            self._emit_ship_span(t, outcome="ok")
             req = self._by_name[t.dst].find_request(t.rid)
             self.handles[t.rid] = req
             self.placement[t.rid] = t.dst
@@ -337,6 +382,7 @@ class DisaggRouter(FleetRouter):
         if t.attempts > self.fault_retries:
             self._fallback(t, reason=reason)
             return
+        self._emit_ship_span(t, outcome="retry", reason=reason)
         t.backoff_until = self.round + (1 << t.attempts)
         self._emit_retry(t, reason=reason,
                          backoff_rounds=t.backoff_until - self.round)
@@ -347,6 +393,24 @@ class DisaggRouter(FleetRouter):
                    to_replica=t.dst, attempt=t.attempts,
                    reason=reason, **extra)
 
+    def _emit_ship_span(self, t: _Transfer, *, outcome: str,
+                        reason: Optional[str] = None) -> None:
+        """Close the CURRENT attempt's ``kv_ship`` span with a typed
+        outcome — one span per drive attempt, parented on the
+        sender's ``kv_export`` span (carried in the transfer record);
+        retries/fallbacks/retargets are outcomes, not separate
+        kinds."""
+        if t.span_id is None:
+            return
+        ev: Dict[str, Any] = dict(
+            rid=t.rid, span_id=t.span_id,
+            parent_id=t.record.get("export_span"), kind="kv_ship",
+            t_start=t.span_t0, t_end=self._clock(), replica=t.src,
+            attempt=t.span_attempt, outcome=outcome)
+        if reason is not None:
+            ev["reason"] = reason
+        self._by_name[t.src].engine._emit("span", **ev)
+
     def _fallback(self, t: _Transfer, *, reason: str) -> None:
         """Graceful degradation past the retry budget: the request
         record migrates to the decode replica over the ordinary
@@ -356,10 +420,14 @@ class DisaggRouter(FleetRouter):
         actually landed and only its reply was lost, the migrate
         handler's rid-dedupe finds the request live and adopts
         nothing — the rebind below picks up the shipped copy."""
+        self._emit_ship_span(t, outcome="fallback", reason=reason)
         self._emit("kv_ship_fallback", rid=t.rid, from_replica=t.src,
                    to_replica=t.dst, attempts=t.attempts, reason=reason)
-        self._call_with_retry(t.dst, "migrate",
-                              {"records": [t.record]})
+        self._call_with_retry(
+            t.dst, "migrate", {"records": [t.record]},
+            trace=({"rid": t.rid, "span_id": t.span_id,
+                    "attempt": t.span_attempt}
+                   if t.span_id is not None else None))
         req = self._by_name[t.dst].find_request(t.rid)
         self.handles[t.rid] = req
         self.placement[t.rid] = t.dst

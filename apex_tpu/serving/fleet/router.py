@@ -288,8 +288,16 @@ class FleetRouter:
             except TransportCorruption:
                 self._fence(rep, cause="transport_corruption")
 
+    def _clock(self) -> float:
+        """The fleet's shared clock (all replicas share ONE clock by
+        construction — see the module docstring), read through any
+        replica's engine."""
+        return self.replicas[0].engine.clock()
+
     def _call_with_retry(self, dst: str, msg_class: str,
-                         payload: Dict[str, Any]) -> Dict[str, Any]:
+                         payload: Dict[str, Any], *,
+                         trace: Optional[Dict[str, Any]] = None
+                         ) -> Dict[str, Any]:
         """Transport call with the router's bounded retry budget:
         ``fault_retries + 1`` immediate attempts absorbing in-flight
         loss/corruption (each retry re-serializes, so a corrupted
@@ -301,7 +309,8 @@ class FleetRouter:
         last: Optional[Exception] = None
         for _ in range(self.fault_retries + 1):
             try:
-                return self.transport.call(dst, msg_class, payload)
+                return self.transport.call(dst, msg_class, payload,
+                                           trace=trace)
             except (TransportTimeout, TransportCorruption) as e:
                 last = e
         raise RuntimeError(
@@ -331,6 +340,12 @@ class FleetRouter:
         self._emit("replica_fence", replica=rep.name, cause=cause,
                    live_requests=live, recoveries=rep.engine.recoveries,
                    fault_retries=rep.fault_attempts)
+        # r19 flight recorder: a fence is a fault boundary — dump the
+        # fenced replica's recent-event ring while the evidence is hot
+        from apex_tpu.telemetry.tracing import maybe_dump_flight_record
+        maybe_dump_flight_record(rep.engine.telemetry,
+                                 f"replica_fence:{cause}",
+                                 step=self.round)
         if migrate:
             self._migrate_requests(rep)
 
@@ -369,6 +384,10 @@ class FleetRouter:
                        requests=len(e.unplaceable),
                        pages_required=e.pages_required,
                        pages_available=e.pages_available)
+            from apex_tpu.telemetry.tracing import \
+                maybe_dump_flight_record
+            maybe_dump_flight_record(self.telemetry, "migrate_refused",
+                                     step=self.round)
             raise
         moved: List[Request] = []
         for name, recs in sorted(plan.items()):
@@ -383,8 +402,21 @@ class FleetRouter:
                            from_replica=source.name, to_replica=name,
                            tokens_done=len(req.generated),
                            was_running=bool(rec["was_running"]))
+                self._emit_hop_span(req.rid, source.name, name)
                 moved.append(req)
         return moved
+
+    def _emit_hop_span(self, rid: int, src: str, dst: str) -> None:
+        """Point ``migrate_hop`` span on the fleet bus (r19).  Root
+        level (no parent): a hop can move a QUEUED request whose
+        admission spans never existed, so parenting on them would
+        dangle; the trace CLI stitches hops to the rid's tree by
+        trace id alone."""
+        now = self._clock()
+        self._emit("span", rid=rid,
+                   span_id=f"{rid}:migrate_hop:{src}:{dst}:{self.round}",
+                   kind="migrate_hop", t_start=now, t_end=now,
+                   replica=src)
 
     # -- the fleet round -------------------------------------------------
 
@@ -537,3 +569,4 @@ def rolling_restart(router: FleetRouter, *, serve_between: int = 0) -> None:
                                  from_replica=rep.name, to_replica=rep.name,
                                  tokens_done=len(req.generated),
                                  was_running=bool(rec["was_running"]))
+                    router._emit_hop_span(req.rid, rep.name, rep.name)

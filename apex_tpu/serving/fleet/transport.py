@@ -111,7 +111,18 @@ def _body_crc(payload: Any) -> int:
 
 class Transport:
     """The seam: message-class handlers register per destination, and
-    every cross-replica payload goes through :meth:`call`."""
+    every cross-replica payload goes through :meth:`call`.
+
+    ``trace`` (r19) is the distributed-tracing context: an opaque
+    JSON-serializable dict carried VERBATIM inside the wire envelope
+    (never inside the payload, so payload CRC / corruption faults
+    cannot touch it), exposed to the receiving handler as
+    :attr:`current_trace` for the duration of its dispatch.  Span
+    identity lives in the context itself — transport msg ids are
+    useless for it, since sender retries mint fresh ones."""
+
+    #: the in-flight message's trace context while its handler runs
+    current_trace: Optional[Dict[str, Any]] = None
 
     def register(self, dst: str, msg_class: str,
                  handler: Callable[[Dict[str, Any]], Dict[str, Any]]
@@ -119,6 +130,7 @@ class Transport:
         raise NotImplementedError
 
     def call(self, dst: str, msg_class: str, payload: Dict[str, Any],
+             *, trace: Optional[Dict[str, Any]] = None
              ) -> Dict[str, Any]:
         raise NotImplementedError
 
@@ -138,6 +150,7 @@ class LocalTransport(Transport):
         #: wire message; bounded by the life of the transport, which
         #: is the life of the fleet — a few bytes per message)
         self._replies: Dict[int, str] = {}
+        self.current_trace: Optional[Dict[str, Any]] = None
 
     # -- registration -----------------------------------------------------
 
@@ -149,13 +162,18 @@ class LocalTransport(Transport):
     # -- the pipeline ------------------------------------------------------
 
     def serialize(self, dst: str, msg_class: str,
-                  payload: Dict[str, Any]) -> str:
+                  payload: Dict[str, Any],
+                  trace: Optional[Dict[str, Any]] = None) -> str:
         """Mint a message: assign the next msg id, stamp the body
-        CRC, return the JSON wire text."""
+        CRC, return the JSON wire text.  ``trace`` rides in the
+        envelope OUTSIDE the payload: the body CRC does not cover it,
+        corruption faults do not touch it, and duplicated wire copies
+        carry the identical context — span ids stay idempotent."""
         msg_id = self._next_msg_id
         self._next_msg_id += 1
         return json.dumps({"msg_id": msg_id, "class": msg_class,
                            "dst": dst, "payload": payload,
+                           "trace": trace,
                            "body_crc": _body_crc(payload)})
 
     def deliver(self, wire: str) -> str:
@@ -180,6 +198,7 @@ class LocalTransport(Transport):
             raise KeyError(
                 f"no handler for class {env['class']!r} on "
                 f"{env['dst']!r} — register before calling")
+        self.current_trace = env.get("trace")
         try:
             out = handler(env["payload"])
         except Exception as e:   # noqa: BLE001 — typed re-raise below
@@ -187,6 +206,8 @@ class LocalTransport(Transport):
                 raise
             out = {"__error__": {"type": type(e).__name__,
                                  "message": str(e)}}
+        finally:
+            self.current_trace = None
         reply = json.dumps(out)
         self._replies[msg_id] = reply
         return reply
@@ -203,9 +224,10 @@ class LocalTransport(Transport):
         return reply
 
     def call(self, dst: str, msg_class: str, payload: Dict[str, Any],
+             *, trace: Optional[Dict[str, Any]] = None
              ) -> Dict[str, Any]:
         return self.deserialize_reply(
-            self.deliver(self.serialize(dst, msg_class, payload)))
+            self.deliver(self.serialize(dst, msg_class, payload, trace)))
 
 
 #: The injectable fault classes, in injection-priority order (at most
@@ -249,6 +271,12 @@ class ChaosTransport(Transport):
 
     def register(self, dst, msg_class, handler) -> None:
         self.inner.register(dst, msg_class, handler)
+
+    @property
+    def current_trace(self) -> Optional[Dict[str, Any]]:
+        # handlers dispatch on the inner transport; delegate so code
+        # holding the chaos wrapper sees the same context
+        return self.inner.current_trace
 
     # -- fault selection ---------------------------------------------------
 
@@ -304,9 +332,10 @@ class ChaosTransport(Transport):
     # -- the wrapped call --------------------------------------------------
 
     def call(self, dst: str, msg_class: str, payload: Dict[str, Any],
+             *, trace: Optional[Dict[str, Any]] = None
              ) -> Dict[str, Any]:
         fault = self._pick(msg_class)
-        wire = self.inner.serialize(dst, msg_class, payload)
+        wire = self.inner.serialize(dst, msg_class, payload, trace)
         if fault == "drop":
             self._emit(fault, msg_class, dst)
             raise TransportTimeout(

@@ -120,6 +120,15 @@ class Request:
     preemptions: int = 0
     admit_t: Optional[float] = None
     first_token_t: Optional[float] = None
+    # r19 shipping-aware SLO accounting: when the first sampled token
+    # became STREAMABLE — equal to first_token_t on a colocated path,
+    # but a disaggregated request's first token is not client-visible
+    # until its KV pages land on the decode replica, so adopt_prefilled
+    # stamps adoption time here and the kv_ship wall below.  TTFT is
+    # measured against stream_t; the ship wall moves into TTFT (where
+    # the SLO feels it), not TPOT.
+    stream_t: Optional[float] = None
+    ship_s: float = 0.0
     finish_t: Optional[float] = None
     finish_reason: Optional[str] = None
 

@@ -20,10 +20,17 @@ capture, per-op attribution) is :mod:`apex_tpu.profiling`:
   capture + phase/collective/HBM attribution through the bus
   (``profile``/``memory`` events), overhead booked to its own goodput
   bucket and budget-bounded ≤1%;
+- **tracing** — :mod:`apex_tpu.telemetry.tracing` (ISSUE 19):
+  request-scoped causal spans over the fleet (``span`` events; trace
+  id = fleet rid), reconstruction of per-request span trees from any
+  set of per-replica streams, critical-path extraction, TTFT
+  decomposition, and the fleet flight recorder
+  (:func:`~apex_tpu.telemetry.tracing.maybe_dump_flight_record`);
 - **CLI** — ``python -m apex_tpu.telemetry summarize run.jsonl``
   (p50/p95/p99 step time, goodput %, phase breakdown, event counts,
   ``--diff`` A/B; ``regress A.json B.json --max-regress PCT`` — the
-  BENCH-record CI gate).
+  BENCH-record CI gate; ``trace STREAM.jsonl...`` — span-tree
+  reconstruction + TTFT decomposition).
 
 See ``docs/telemetry.md`` for the event schema and wiring examples.
 """
@@ -64,10 +71,36 @@ from apex_tpu.telemetry.summarize import (  # noqa: F401
     summarize_events,
     summarize_file,
 )
+from apex_tpu.telemetry.tracing import (  # noqa: F401
+    SPAN_KINDS,
+    TTFT_SUM_TOLERANCE_MS,
+    Span,
+    Trace,
+    admission_life,
+    build_traces,
+    critical_path,
+    load_trace_streams,
+    maybe_dump_flight_record,
+    run_trace_cli,
+    ttft_decomposition,
+    validate_trace,
+)
 
 __all__ = [
     "EVENT_TYPES",
     "FlightRecorder",
+    "SPAN_KINDS",
+    "Span",
+    "TTFT_SUM_TOLERANCE_MS",
+    "Trace",
+    "admission_life",
+    "build_traces",
+    "critical_path",
+    "load_trace_streams",
+    "maybe_dump_flight_record",
+    "run_trace_cli",
+    "ttft_decomposition",
+    "validate_trace",
     "JsonlSink",
     "MemorySink",
     "PAUSE_KINDS",

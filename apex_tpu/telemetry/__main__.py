@@ -14,6 +14,14 @@ Subcommands:
   per-key direction rules and exits 1 when any gated key regressed
   more than PCT percent (``--keys`` restricts and makes the named keys
   mandatory; ``--verbose`` prints every compared row).
+- ``trace STREAM.jsonl [STREAM2.jsonl ...]`` — reconstruct per-request
+  span trees from any set of per-replica streams (ISSUE 19): renders
+  each request's causal tree, marks the critical path, and prints the
+  TTFT decomposition.  ``--rid N`` restricts to one request (exit 2
+  when it has no spans); ``--json`` emits the trees + decompositions
+  as a record.  Exit 1 when any tree is structurally broken (orphan
+  spans, dangling parents) or a decomposition fails to sum to the
+  measured TTFT within tolerance.
 """
 
 from __future__ import annotations
@@ -62,7 +70,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                        help="print every compared row, not just "
                             "failures")
 
+    p_tr = sub.add_parser(
+        "trace", help="reconstruct per-request span trees from one or "
+                      "more per-replica streams (exit 1 on broken "
+                      "trees or TTFT decomposition mismatch)")
+    p_tr.add_argument("jsonl", nargs="+",
+                      help="telemetry JSONL stream(s) — any subset of "
+                           "the fleet's per-replica files")
+    p_tr.add_argument("--rid", type=int, default=None,
+                      help="restrict to one request id (exit 2 when "
+                           "it has no spans)")
+    p_tr.add_argument("--json", action="store_true",
+                      help="emit trees + decompositions as JSON")
+
     args = parser.parse_args(argv)
+
+    if args.cmd == "trace":
+        from apex_tpu.telemetry.tracing import run_trace_cli
+
+        return run_trace_cli(args.jsonl, rid=args.rid,
+                             as_json=args.json)
 
     if args.cmd == "regress":
         from apex_tpu.telemetry.regress import (

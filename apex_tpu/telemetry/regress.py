@@ -71,6 +71,16 @@ GATED_LOWER = (
     # the right retry count depends on the injected fault rate, so
     # the gate must not guess a direction for it.
     r"_ship_fallback_rate$",
+    # r19: the TTFT decomposition family (fleet_ttft_queue_ms /
+    # fleet_ttft_prefill_ms / fleet_ttft_ship_ms /
+    # fleet_ttft_decode_wait_ms, and their serving_* summarize twins)
+    # — span-derived attribution of WHERE the first-token wait went.
+    # Deliberately redundant with the ttft/_ms$ rules above, same
+    # contract as the bucket/fleet entries: this entry DOCUMENTS that
+    # the committed r19 pair gates the family (direction pinned by
+    # test_ttft_decomposition_direction_rules), it adds no new
+    # coverage.
+    r"ttft_\w*(queue|prefill|ship|decode_wait)_ms$",
 )
 
 #: Higher-is-better key patterns: throughput, efficiency, rooflines,

@@ -211,6 +211,13 @@ EVENT_FIELDS: Dict[str, Dict[str, FieldSpec]] = {
         "tpot_ms": opt(*NUMBER),
         # a REAL bool, present only when the request carried a deadline
         "deadline_hit": opt(bool),
+        # r19 shipping-aware SLO accounting: the kv_ship wall this
+        # request paid between prefill-side export and decode-side
+        # adoption (== its kv_export.start -> kv_import.end span
+        # segment).  Present only on shipped requests — ttft_ms on
+        # those is STREAM TTFT (first token available to the decode
+        # replica), so the ship wall lands in TTFT, not TPOT
+        "ship_ms": opt(*NUMBER),
     },
     "decode_step": {
         "batch": req(int),
@@ -330,6 +337,42 @@ EVENT_FIELDS: Dict[str, Dict[str, FieldSpec]] = {
         "to_replica": req(str),
         "attempts": req(int),
         "reason": req(str, choices=("timeout", "corrupt",
+                                    "crc_mismatch", "missing_pages",
+                                    "no_capacity")),
+    },
+    # distributed request tracing (r19): one `span` event per closed
+    # causal interval in a request's fleet-wide life.  trace_id IS the
+    # fleet rid; span_id/parent_id are DERIVED from application-level
+    # identity (rid, admission life, transfer attempt, hop endpoints)
+    # — never from transport msg ids, whose sender retries mint fresh
+    # ones — so re-emission under at-most-once redelivery is
+    # idempotent (reconstruction merges identical ids).  t_start/t_end
+    # are on the fleet's SHARED engine clock (monotonic / SimClock),
+    # NOT the per-bus stamp `t`, so spans recorded on different
+    # replicas' streams join on one time base.  kind is CLOSED;
+    # kv_ship spans carry one span PER ATTEMPT with the outcome typed
+    # (ok / retry / fallback / retarget) and the retry reason
+    "span": {
+        "rid": req(int),
+        "span_id": req(str),
+        # absent = root-level span of its trace (never a dangling ref)
+        "parent_id": opt(str),
+        "kind": req(str, choices=("queue_wait", "admit",
+                                  "prefill_chunk", "kv_export",
+                                  "kv_ship", "kv_import",
+                                  "decode_wait", "decode_steps",
+                                  "migrate_hop", "stream_emit")),
+        "t_start": req(*NUMBER),
+        "t_end": req(*NUMBER),
+        # emitting side, when fleet-scoped (absent on bare engines)
+        "replica": opt(str),
+        # kv_ship / kv_import: 1-based transfer attempt
+        "attempt": opt(int),
+        # kv_ship per-attempt outcome — typed annotations, CLOSED
+        "outcome": opt(str, choices=("ok", "retry", "fallback",
+                                     "retarget")),
+        # retry/fallback cause (the kv_ship_retry reason vocabulary)
+        "reason": opt(str, choices=("timeout", "corrupt",
                                     "crc_mismatch", "missing_pages",
                                     "no_capacity")),
     },

@@ -186,6 +186,22 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         out["serving_ship_fallback_rate"] = (
             round(fallbacks / (ships + fallbacks), 4)
             if ships + fallbacks else None)
+        # distributed tracing (r19): p50 TTFT decomposition over every
+        # request whose span tree is complete enough to decompose —
+        # where the waiting actually happened, not just how long it was
+        if counts.get("span"):
+            from apex_tpu.telemetry.tracing import (build_traces,
+                                                    ttft_decomposition)
+            decomps = [d for d in (ttft_decomposition(t)
+                                   for t in build_traces(events).values())
+                       if d is not None]
+            if decomps:
+                out["serving_traced_requests"] = len(decomps)
+                for comp in ("ttft_queue_ms", "ttft_prefill_ms",
+                             "ttft_ship_ms", "ttft_decode_wait_ms"):
+                    vals = sorted(d[comp] for d in decomps)
+                    out[f"serving_{comp}"] = round(
+                        percentile(vals, 0.50), 3)
     if counts.get("profile"):
         # phase attribution (ISSUE 9): mean per-phase device ms over the
         # run's sampled windows — the answer to "where do a step's
@@ -284,6 +300,13 @@ def format_summary(s: Dict[str, Any]) -> str:
                 f"ship ok {_pct(s['serving_ship_success_rate'])} "
                 f"fallback {_pct(s.get('serving_ship_fallback_rate'))}")
         lines.append("  ".join(parts))
+        if s.get("serving_traced_requests"):
+            lines.append(
+                f"ttft split  queue {_ms(s.get('serving_ttft_queue_ms'))}"
+                f"  prefill {_ms(s.get('serving_ttft_prefill_ms'))}"
+                f"  ship {_ms(s.get('serving_ttft_ship_ms'))}"
+                f"  decode-wait {_ms(s.get('serving_ttft_decode_wait_ms'))}"
+                f"  (p50 over {s['serving_traced_requests']} traces)")
     if s.get("profile_samples"):
         parts = ["phases      " + "  ".join(
             f"{k} {v:.2f}ms" for k, v in (s.get("phase_ms") or {}).items())]
@@ -334,6 +357,12 @@ _DIFF_ROWS = (
     # disaggregation health (r18): did the change push shipments past
     # their retry budget into local-prefill fallbacks?
     ("serving_ship_fallback_rate", "ship fallback", "{:.3f}"),
+    # TTFT decomposition (r19): WHERE did the first-token wait move —
+    # intake queue, prefill compute, the KV ship wall, or decode entry?
+    ("serving_ttft_queue_ms", "ttft queue", "{:.2f}"),
+    ("serving_ttft_prefill_ms", "ttft prefill", "{:.2f}"),
+    ("serving_ttft_ship_ms", "ttft ship", "{:.2f}"),
+    ("serving_ttft_decode_wait_ms", "ttft dec-wait", "{:.2f}"),
     # phase-attribution rows (ISSUE 9): did the change move exposed
     # communication or the memory high-water mark?
     ("exposed_collective_ms", "exposed (ms)", "{:.2f}"),

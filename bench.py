@@ -2274,6 +2274,21 @@ def bench_fleet():
     dropped = [r for r in steady + restart
                if fleet.handles[r].finish_reason
                not in ("eos", "length")]
+    # r19: span-derived TTFT decomposition over the recorded stream —
+    # the keys are ALWAYS present (0.0 when nothing decomposed) so the
+    # committed pair's --keys list holds on both sides of the A/B; the
+    # ship component attributes the disagg tier's kv_export -> kv_import
+    # wall, and reads ~0 on the colocated side by construction
+    from apex_tpu.telemetry.tracing import (build_traces,
+                                            ttft_decomposition)
+    decomps = [d for d in (ttft_decomposition(t)
+                           for t in build_traces(mem.events).values())
+               if d is not None]
+
+    def _decomp_p50(comp):
+        vals = sorted(d[comp] for d in decomps)
+        return round(percentile(vals, 0.50), 3) if vals else 0.0
+
     return {
         "fleet_requests": len(steady) + len(restart),
         "fleet_dropped": len(dropped),          # must stay 0
@@ -2295,6 +2310,14 @@ def bench_fleet():
         round(ship_falls / ship_outcomes, 4) if ship_outcomes else 0.0,
         "fleet_ship_retry_rate":
         round(ship_retries / ship_outcomes, 4) if ship_outcomes else 0.0,
+        # TTFT decomposition (r19): p50 per component; the four sum to
+        # the traced p50 TTFT request-by-request (exact telescoping —
+        # test_tracing pins it); gated via the ttft family rule
+        "fleet_traced_requests": len(decomps),
+        "fleet_ttft_queue_ms": _decomp_p50("ttft_queue_ms"),
+        "fleet_ttft_prefill_ms": _decomp_p50("ttft_prefill_ms"),
+        "fleet_ttft_ship_ms": _decomp_p50("ttft_ship_ms"),
+        "fleet_ttft_decode_wait_ms": _decomp_p50("ttft_decode_wait_ms"),
         "fleet_compile_s": round(compile_s, 2),
         "fleet_stream_events": n_events,
         "fleet_telemetry_file": os.path.basename(stream),
